@@ -1,0 +1,23 @@
+"""openwhisk_tpu — a TPU-native serverless (FaaS) control plane.
+
+A ground-up rebuild of the capabilities of Apache OpenWhisk (reference:
+/root/reference, Scala/Akka) designed TPU-first: the controller's activation
+placement decisions are computed by a JAX/XLA vectorized bin-packing kernel
+over device-resident invoker state (see `openwhisk_tpu.ops.placement` and
+`openwhisk_tpu.controller.loadbalancer.tpu_balancer`), shardable over a
+`jax.sharding.Mesh` for fleets of up to 64k invokers.
+
+Layer map (mirrors reference SURVEY.md §1):
+  controller/   REST API, entitlement, load balancing   (ref: core/controller)
+  invoker/      activation execution loop               (ref: core/invoker)
+  containerpool container lifecycle + drivers           (ref: core/invoker/containerpool)
+  messaging/    bus abstraction + in-memory/kafka-like  (ref: common/.../connector)
+  database/     artifact/activation stores + caching    (ref: common/.../database)
+  core/entity/  domain model                            (ref: common/.../entity)
+  ops/          JAX/Pallas device kernels (placement, throttling)
+  parallel/     mesh/sharding for multi-chip balancer state
+  models/       placement policy models (sharding-parity, batched bin-pack)
+  utils/        logging, transactions, semaphores, scheduling, config
+"""
+
+__version__ = "0.1.0"

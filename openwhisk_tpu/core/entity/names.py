@@ -1,0 +1,139 @@
+"""Entity naming: names, paths, fully-qualified names.
+
+Refs: EntityName/EntityPath in common/scala/.../core/entity/EntityPath.scala,
+FullyQualifiedEntityName.scala. A path is /namespace[/package]; the default
+namespace placeholder is "_" and resolves to the subject's own namespace.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+ENTITY_NAME_RX = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9@ _\-.]*$")
+DEFAULT_NAMESPACE = "_"
+MAX_NAME_LENGTH = 256
+
+
+@dataclass(frozen=True)
+class EntityName:
+    name: str
+
+    def __post_init__(self):
+        if not self.name or len(self.name) > MAX_NAME_LENGTH or not ENTITY_NAME_RX.match(self.name):
+            raise ValueError(f"name {self.name!r} is not a valid entity name")
+
+    def to_path(self) -> "EntityPath":
+        return EntityPath(self.name)
+
+    def to_json(self):
+        return self.name
+
+    def __str__(self):
+        return self.name
+
+
+@dataclass(frozen=True)
+class EntityPath:
+    """Slash-separated path: "namespace" or "namespace/package"."""
+    path: str
+
+    def __post_init__(self):
+        segs = self.segments
+        if not segs or any(not s for s in segs):
+            raise ValueError(f"path {self.path!r} is not a valid entity path")
+        for s in segs:
+            if s != DEFAULT_NAMESPACE and not ENTITY_NAME_RX.match(s):
+                raise ValueError(f"path segment {s!r} is not valid")
+
+    @property
+    def segments(self):
+        return [s for s in self.path.strip("/").split("/") if s != ""]
+
+    @property
+    def root(self) -> EntityName:
+        seg = self.segments[0]
+        return EntityName(seg) if seg != DEFAULT_NAMESPACE else EntityName("_default_")
+
+    @property
+    def root_str(self) -> str:
+        return self.segments[0]
+
+    @property
+    def default_package(self) -> bool:
+        return len(self.segments) == 1
+
+    @property
+    def is_default_namespace(self) -> bool:
+        return self.segments[0] == DEFAULT_NAMESPACE
+
+    def resolve_namespace(self, namespace: str) -> "EntityPath":
+        """Replace a leading "_" with the subject's namespace
+        (ref EntityPath.resolveNamespace)."""
+        segs = self.segments
+        if segs[0] == DEFAULT_NAMESPACE:
+            return EntityPath("/".join([namespace] + segs[1:]))
+        return self
+
+    def add(self, name) -> "EntityPath":
+        return EntityPath(self.path.strip("/") + "/" + str(name))
+
+    @property
+    def rel_path(self) -> Optional["EntityPath"]:
+        """Path without the root namespace, if any."""
+        segs = self.segments
+        return EntityPath("/".join(segs[1:])) if len(segs) > 1 else None
+
+    def to_fqn(self) -> "FullyQualifiedEntityName":
+        segs = self.segments
+        return FullyQualifiedEntityName(EntityPath("/".join(segs[:-1])), EntityName(segs[-1]))
+
+    def to_json(self):
+        return "/".join(self.segments)
+
+    def __str__(self):
+        return "/".join(self.segments)
+
+
+@dataclass(frozen=True)
+class FullyQualifiedEntityName:
+    """path + name, e.g. namespace/package + action."""
+    path: EntityPath
+    name: EntityName
+    version: Optional[object] = None
+
+    @classmethod
+    def parse(cls, fqn: str) -> "FullyQualifiedEntityName":
+        segs = [s for s in fqn.strip("/").split("/") if s]
+        if len(segs) < 2:
+            raise ValueError(f"{fqn!r} is not a fully qualified entity name")
+        return cls(EntityPath("/".join(segs[:-1])), EntityName(segs[-1]))
+
+    @property
+    def fully_qualified_name(self) -> str:
+        return f"{self.path}/{self.name}"
+
+    @property
+    def namespace(self) -> str:
+        return self.path.root_str
+
+    def resolve(self, namespace: str) -> "FullyQualifiedEntityName":
+        return FullyQualifiedEntityName(self.path.resolve_namespace(namespace), self.name, self.version)
+
+    def add(self, name) -> "FullyQualifiedEntityName":
+        return FullyQualifiedEntityName(self.path.add(self.name), EntityName(str(name)))
+
+    def to_doc_id(self) -> str:
+        return self.fully_qualified_name
+
+    def to_json(self):
+        return {"path": self.path.to_json(), "name": self.name.to_json()}
+
+    @classmethod
+    def from_json(cls, j) -> "FullyQualifiedEntityName":
+        if isinstance(j, str):
+            return cls.parse(j)
+        return cls(EntityPath(j["path"]), EntityName(j["name"]))
+
+    def __str__(self):
+        return self.fully_qualified_name

@@ -1,0 +1,12 @@
+from .store import (ArtifactStore, ArtifactStoreException, DocumentConflict,
+                    NoDocumentException, StaleParameter)
+from .memory_store import MemoryArtifactStore, MemoryArtifactStoreProvider
+from .sqlite_store import SqliteArtifactStore, SqliteArtifactStoreProvider
+from .batcher import Batcher
+from .cache import EntityCache, RemoteCacheInvalidation
+from .entities import EntityStore, AuthStore
+from .activation_store import (ActivationStore, ArtifactActivationStore,
+                               ArtifactActivationStoreProvider,
+                               NoopActivationStore)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

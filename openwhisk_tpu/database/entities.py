@@ -1,0 +1,145 @@
+"""Typed entity store: cached CRUD over the ArtifactStore.
+
+Rebuild of the WhiskEntityStore/WhiskAuthStore helpers
+(common/scala/.../core/entity/WhiskStore.scala): typed get/put/delete with a
+revision-keyed read-through cache and cross-instance invalidation hooks —
+the controller's view of persistence (SURVEY §3.5).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Type
+
+from ..core.entity import (Identity, WhiskAction, WhiskActivation, WhiskEntity,
+                           WhiskAuthRecord, WhiskPackage, WhiskRule, WhiskTrigger)
+from ..core.entity.ids import DocRevision
+from .cache import EntityCache
+from .store import ArtifactStore, NoDocumentException
+
+_TYPES = {
+    "actions": WhiskAction,
+    "triggers": WhiskTrigger,
+    "rules": WhiskRule,
+    "packages": WhiskPackage,
+}
+
+
+class EntityStore:
+    def __init__(self, store: ArtifactStore, cache: Optional[EntityCache] = None,
+                 on_invalidate: Optional[Callable] = None):
+        self.store = store
+        self.cache = cache if cache is not None else EntityCache()
+        self.on_invalidate = on_invalidate  # async (key) -> None, bus notify
+
+    async def _notify(self, key: str) -> None:
+        if self.on_invalidate is not None:
+            await self.on_invalidate(key)
+
+    async def put(self, entity: WhiskEntity) -> DocRevision:
+        doc = entity.to_document()
+        rev = await self.store.put(entity.docid, doc,
+                                   entity.rev.rev if not entity.rev.empty else None)
+        entity.rev = DocRevision(rev)
+        self.cache.update(entity.docid, entity)
+        await self._notify(entity.docid)
+        return entity.rev
+
+    async def get(self, cls: Type, doc_id: str, use_cache: bool = True):
+        async def load():
+            doc = await self.store.get(doc_id)
+            ent = cls.from_json(doc)
+            ent.rev = DocRevision(doc.get("_rev"))
+            return ent
+
+        if use_cache:
+            return await self.cache.get_or_load(doc_id, load)
+        return await load()
+
+    async def get_action(self, doc_id: str) -> WhiskAction:
+        return await self.get(WhiskAction, doc_id)
+
+    async def get_trigger(self, doc_id: str) -> WhiskTrigger:
+        return await self.get(WhiskTrigger, doc_id)
+
+    async def get_rule(self, doc_id: str) -> WhiskRule:
+        return await self.get(WhiskRule, doc_id)
+
+    async def get_package(self, doc_id: str) -> WhiskPackage:
+        return await self.get(WhiskPackage, doc_id)
+
+    async def delete(self, entity: WhiskEntity) -> bool:
+        ok = await self.store.delete(entity.docid,
+                                     entity.rev.rev if not entity.rev.empty else None)
+        self.cache.invalidate(entity.docid)
+        await self.store.delete_attachments(entity.docid)
+        await self._notify(entity.docid)
+        return ok
+
+    async def list(self, collection: str, namespace: str, skip: int = 0,
+                   limit: int = 30, descending: bool = True) -> List[dict]:
+        return await self.store.query(collection, namespace, skip=skip,
+                                      limit=limit, descending=descending)
+
+    def entity_class(self, collection: str) -> Type:
+        return _TYPES[collection]
+
+
+class AuthStore:
+    """Subject/identity store (ref WhiskAuthStore + Identity views).
+
+    Identities are looked up by (a) basic-auth uuid:key on every request and
+    (b) namespace name for package resolution; both paths are cached.
+    """
+
+    COLLECTION = "subjects"
+
+    def __init__(self, store: ArtifactStore, cache: Optional[EntityCache] = None):
+        self.store = store
+        self.cache = cache if cache is not None else EntityCache(ttl_seconds=60)
+
+    async def put(self, record: WhiskAuthRecord) -> None:
+        doc = record.to_json()
+        doc["entityType"] = self.COLLECTION
+        doc["namespace"] = str(record.subject)
+        doc["name"] = str(record.subject)
+        doc["updated"] = 0
+        try:
+            existing = await self.store.get(f"subject/{record.subject}")
+            rev = existing.get("_rev")
+        except NoDocumentException:
+            rev = None
+        await self.store.put(f"subject/{record.subject}", doc, rev)
+        for ident in record.identities():
+            self.cache.update(f"uuid/{ident.authkey.uuid.asString}", ident)
+            self.cache.update(f"ns/{ident.namespace.name}", ident)
+
+    async def identity_by_key(self, uuid: str, key: str) -> Optional[Identity]:
+        ident = await self._find("uuid/" + uuid,
+                                 lambda i: i.authkey.uuid.asString == uuid)
+        if ident is not None and ident.authkey.key.asString == key:
+            return ident
+        return None
+
+    async def identity_by_namespace(self, namespace: str) -> Optional[Identity]:
+        return await self._find("ns/" + namespace,
+                                lambda i: str(i.namespace.name) == namespace)
+
+    async def _find(self, cache_key: str, pred) -> Optional[Identity]:
+        async def load():
+            docs = await self.store.query(self.COLLECTION)
+            for d in docs:
+                rec = WhiskAuthRecord.from_json(d)
+                if rec.blocked:
+                    continue
+                for ident in rec.identities():
+                    if pred(ident):
+                        return ident
+            return None
+
+        try:
+            return await self.cache.get_or_load(cache_key, load)
+        except NoDocumentException:
+            return None
+
+    async def subjects(self) -> List[WhiskAuthRecord]:
+        docs = await self.store.query(self.COLLECTION)
+        return [WhiskAuthRecord.from_json(d) for d in docs]

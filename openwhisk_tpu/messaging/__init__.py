@@ -1,0 +1,8 @@
+from .message import (AcknowledgementMessage, ActivationMessage,
+                      CombinedCompletionAndResultMessage, CompletionMessage,
+                      EventMessage, Message, PingMessage, ResultMessage,
+                      parse_ack)
+from .connector import MessageConsumer, MessageFeed, MessageProducer, MessagingProvider
+from .memory import MemoryMessagingProvider
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,135 @@
+"""In-memory message bus.
+
+Rebuild of the reference's lean connector (common/scala/.../connector/lean/:
+LeanMessagingProvider/LeanProducer/LeanConsumer — a BlockingQueue per topic),
+used for single-process deployments and as the test bus (the reference's
+TestConnector pattern, tests/.../connector/test/TestConnector.scala:36-109).
+
+Competing consumers in the same group share a queue (each message is
+delivered once per group); distinct groups each get every message — the same
+observable semantics as Kafka consumer groups on a single partition.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .connector import MessageConsumer, MessageProducer, MessagingProvider
+
+
+class _Topic:
+    def __init__(self, name: str):
+        self.name = name
+        self.offset = itertools.count()
+        self.groups: Dict[str, deque] = {}
+        self.cond = asyncio.Condition()
+
+    def queue_for(self, group: str) -> deque:
+        if group not in self.groups:
+            self.groups[group] = deque()
+        return self.groups[group]
+
+
+class MemoryBus:
+    """Topic registry shared by producers/consumers of one provider."""
+
+    def __init__(self):
+        self.topics: Dict[str, _Topic] = {}
+
+    def topic(self, name: str) -> _Topic:
+        t = self.topics.get(name)
+        if t is None:
+            t = _Topic(name)
+            self.topics[name] = t
+        return t
+
+
+class MemoryProducer(MessageProducer):
+    def __init__(self, bus: MemoryBus):
+        self.bus = bus
+        self._sent = 0
+
+    @property
+    def sent_count(self) -> int:
+        return self._sent
+
+    async def send(self, topic: str, msg) -> None:
+        payload = msg if isinstance(msg, (bytes, bytearray)) else msg.serialize()
+        t = self.bus.topic(topic)
+        off = next(t.offset)
+        async with t.cond:
+            for q in t.groups.values():
+                q.append((off, bytes(payload)))
+            if not t.groups:
+                # retain for the first group to subscribe (queue semantics)
+                t.queue_for("__default__").append((off, bytes(payload)))
+            self._sent += 1
+            t.cond.notify_all()
+
+
+class MemoryConsumer(MessageConsumer):
+    def __init__(self, bus: MemoryBus, topic: str, group: str, max_peek: int = 128):
+        self.bus = bus
+        self.topic_name = topic
+        self.group = group
+        self.max_peek = max_peek
+        t = self.bus.topic(topic)
+        # adopt messages produced before any subscriber existed
+        if group not in t.groups and "__default__" in t.groups:
+            t.groups[group] = t.groups.pop("__default__")
+        else:
+            t.queue_for(group)
+        self._uncommitted: List[Tuple[str, int, int, bytes]] = []
+
+    async def peek(self, max_messages: int, timeout: float = 0.5
+                   ) -> List[Tuple[str, int, int, bytes]]:
+        n = min(max_messages, self.max_peek)
+        t = self.bus.topic(self.topic_name)
+        q = t.queue_for(self.group)
+        out: List[Tuple[str, int, int, bytes]] = []
+        async with t.cond:
+            if not q:
+                try:
+                    await asyncio.wait_for(t.cond.wait_for(lambda: len(q) > 0), timeout)
+                except asyncio.TimeoutError:
+                    return []
+            while q and len(out) < n:
+                off, payload = q.popleft()
+                out.append((self.topic_name, 0, off, payload))
+        self._uncommitted = out
+        return out
+
+    def commit(self) -> None:
+        self._uncommitted = []
+
+
+class MemoryMessagingProvider(MessagingProvider):
+    """One bus per instance; `shared()` returns a process-wide bus for
+    lean/standalone mode where controller and invoker live in one process."""
+
+    _shared: Optional["MemoryMessagingProvider"] = None
+
+    def __init__(self):
+        self.bus = MemoryBus()
+
+    @classmethod
+    def shared(cls) -> "MemoryMessagingProvider":
+        if cls._shared is None:
+            cls._shared = cls()
+        return cls._shared
+
+    @classmethod
+    def reset_shared(cls) -> None:
+        cls._shared = None
+
+    def get_producer(self) -> MemoryProducer:
+        return MemoryProducer(self.bus)
+
+    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128) -> MemoryConsumer:
+        return MemoryConsumer(self.bus, topic, group_id, max_peek)
+
+    def ensure_topic(self, topic: str, partitions: int = 1,
+                     retention_bytes: Optional[int] = None) -> None:
+        self.bus.topic(topic)

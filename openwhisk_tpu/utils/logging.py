@@ -1,0 +1,143 @@
+"""Structured logging + in-process metrics.
+
+Rebuilt from the reference's Logging/MetricEmitter
+(common/scala/.../common/Logging.scala:37-120,241-258): log lines are prefixed
+with the transaction id; MetricEmitter keeps counters/histograms/gauges that a
+Prometheus endpoint can scrape (openwhisk_tpu.controller.monitoring).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+_LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
+
+
+class MetricEmitter:
+    """Thread-safe counters / histograms / gauges (ref Logging.scala:241-258).
+
+    Histograms keep (count, sum, min, max) plus a small reservoir for
+    percentile estimates — enough for the /metrics endpoint and tests.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = defaultdict(int)
+        self._gauges: dict[str, float] = {}
+        self._hist: dict[str, list] = {}  # name -> [count, sum, min, max, reservoir]
+
+    def counter(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += delta
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def histogram(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._hist.get(name)
+            if h is None:
+                h = [0, 0.0, float("inf"), float("-inf"), []]
+                self._hist[name] = h
+            h[0] += 1
+            h[1] += value
+            h[2] = min(h[2], value)
+            h[3] = max(h[3], value)
+            res = h[4]
+            if len(res) < 1024:
+                res.append(value)
+            else:  # reservoir-replace
+                res[h[0] % 1024] = value
+
+    # -- read side ---------------------------------------------------------
+    def counter_value(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def gauge_value(self, name: str) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name)
+
+    def histogram_stats(self, name: str) -> Optional[dict]:
+        with self._lock:
+            h = self._hist.get(name)
+            if not h or not h[0]:
+                return None
+            res = sorted(h[4])
+            return {
+                "count": h[0], "sum": h[1], "min": h[2], "max": h[3],
+                "mean": h[1] / h[0],
+                "p50": res[len(res) // 2],
+                "p99": res[min(len(res) - 1, int(len(res) * 0.99))],
+            }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: {"count": v[0], "sum": v[1]} for k, v in self._hist.items()},
+            }
+
+    def prometheus_text(self) -> str:
+        """Render in Prometheus exposition format (ref core/monitoring)."""
+        out = []
+        snap = self.snapshot()
+        for k, v in sorted(snap["counters"].items()):
+            n = _prom_name(k)
+            out.append(f"# TYPE {n} counter\n{n} {v}")
+        for k, v in sorted(snap["gauges"].items()):
+            n = _prom_name(k)
+            out.append(f"# TYPE {n} gauge\n{n} {v}")
+        for k, v in sorted(snap["histograms"].items()):
+            n = _prom_name(k)
+            out.append(f"# TYPE {n} summary\n{n}_count {v['count']}\n{n}_sum {v['sum']}")
+        return "\n".join(out) + "\n"
+
+
+def _prom_name(name: str) -> str:
+    return "openwhisk_" + "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+class Logging:
+    """Base logger: level-filtered, transid-prefixed lines + metric sink."""
+
+    def __init__(self, level: str = "info", metrics: Optional[MetricEmitter] = None,
+                 stream=None):
+        self.level = _LEVELS.get(level, 20)
+        self.metrics = metrics or MetricEmitter()
+        self.stream = stream or sys.stderr
+        self._lock = threading.Lock()
+
+    def emit(self, level: str, transid, message: str, component: str = "") -> None:
+        if _LEVELS.get(level, 20) < self.level:
+            return
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
+        line = f"[{ts}] [{level.upper()}] [{transid}] [{component}] {message}"
+        with self._lock:
+            print(line, file=self.stream)
+
+    def debug(self, transid, msg, component=""):
+        self.emit("debug", transid, msg, component)
+
+    def info(self, transid, msg, component=""):
+        self.emit("info", transid, msg, component)
+
+    def warn(self, transid, msg, component=""):
+        self.emit("warn", transid, msg, component)
+
+    def error(self, transid, msg, component=""):
+        self.emit("error", transid, msg, component)
+
+
+class PrintLogging(Logging):
+    pass
+
+
+class NullLogging(Logging):
+    def emit(self, level, transid, message, component=""):
+        pass

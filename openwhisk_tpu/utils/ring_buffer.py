@@ -1,0 +1,29 @@
+"""Fixed-size ring buffer (ref common/scala/.../utils/RingBuffer.scala).
+
+Used by invoker supervision to keep the last N invocation results
+(InvokerSupervision.scala:435-443 keeps 10 with error tolerance 3).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class RingBuffer(Generic[T]):
+    def __init__(self, size: int):
+        self._buf: Deque[T] = deque(maxlen=size)
+        self.size = size
+
+    def add(self, item: T) -> None:
+        self._buf.append(item)
+
+    def to_list(self) -> List[T]:
+        return list(self._buf)
+
+    def count(self, predicate: Callable[[T], bool]) -> int:
+        return sum(1 for x in self._buf if predicate(x))
+
+    def __len__(self) -> int:
+        return len(self._buf)

@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Multi-chip sharding is tested on a virtual 8-device CPU mesh: JAX must see
+these env vars before its first import, so they are set at conftest import
+time (pytest imports conftest before test modules).
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,214 @@
+"""Database layer tests — store contract run against both backends
+(mirrors reference tests/.../core/database/test behavior-contract style)."""
+import asyncio
+import os
+import tempfile
+
+import pytest
+
+from openwhisk_tpu.core.entity import (ActivationId, ActivationResponse,
+                                       CodeExec, EntityName, EntityPath,
+                                       Identity, Subject, UserLimits,
+                                       WhiskAction, WhiskActivation,
+                                       WhiskAuthRecord)
+from openwhisk_tpu.database import (ArtifactActivationStore, AuthStore,
+                                    Batcher, DocumentConflict, EntityCache,
+                                    EntityStore, MemoryArtifactStore,
+                                    NoDocumentException, RemoteCacheInvalidation,
+                                    SqliteArtifactStore)
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_stores():
+    tmp = tempfile.mktemp(suffix=".db")
+    return [("memory", lambda: MemoryArtifactStore()),
+            ("sqlite", lambda: SqliteArtifactStore(tmp))]
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        return MemoryArtifactStore()
+    return SqliteArtifactStore(str(tmp_path / "whisks.db"))
+
+
+class TestArtifactStoreContract:
+    def test_put_get_delete(self, store):
+        async def go():
+            rev = await store.put("ns/doc", {"entityType": "actions", "namespace": "ns",
+                                             "name": "doc", "updated": 1})
+            d = await store.get("ns/doc")
+            assert d["_rev"] == rev
+            assert d["name"] == "doc"
+            assert await store.delete("ns/doc", rev)
+            with pytest.raises(NoDocumentException):
+                await store.get("ns/doc")
+        run(go())
+
+    def test_conflict_on_blind_update(self, store):
+        async def go():
+            rev = await store.put("ns/doc", {"entityType": "actions", "namespace": "ns",
+                                             "name": "doc", "updated": 1})
+            with pytest.raises(DocumentConflict):
+                await store.put("ns/doc", {"entityType": "actions", "namespace": "ns",
+                                           "name": "doc", "updated": 2})
+            rev2 = await store.put("ns/doc", {"entityType": "actions", "namespace": "ns",
+                                              "name": "doc", "updated": 2}, rev)
+            assert rev2 != rev
+            with pytest.raises(DocumentConflict):
+                await store.put("ns/other", {"entityType": "actions", "namespace": "ns",
+                                             "name": "other", "updated": 1}, rev="1-zzz")
+        run(go())
+
+    def test_query_views(self, store):
+        async def go():
+            for i in range(5):
+                await store.put(f"ns/a{i}", {"entityType": "actions", "namespace": "ns",
+                                             "name": f"a{i}", "updated": i})
+            await store.put("other/b", {"entityType": "actions", "namespace": "other",
+                                        "name": "b", "updated": 10})
+            await store.put("ns/t", {"entityType": "triggers", "namespace": "ns",
+                                     "name": "t", "updated": 3})
+            docs = await store.query("actions", "ns")
+            assert [d["name"] for d in docs] == ["a4", "a3", "a2", "a1", "a0"]
+            docs = await store.query("actions", "ns", limit=2, skip=1)
+            assert [d["name"] for d in docs] == ["a3", "a2"]
+            docs = await store.query("actions", "ns", since=2, upto=3)
+            assert sorted(d["name"] for d in docs) == ["a2", "a3"]
+            assert await store.count("triggers", "ns") == 1
+            # package-scoped entities visible under root namespace
+            await store.put("ns/pkg/c", {"entityType": "actions", "namespace": "ns/pkg",
+                                         "name": "c", "updated": 20})
+            docs = await store.query("actions", "ns")
+            assert docs[0]["name"] == "c"
+        run(go())
+
+    def test_attachments(self, store):
+        async def go():
+            await store.put("ns/doc", {"entityType": "actions", "namespace": "ns",
+                                       "name": "doc", "updated": 1})
+            await store.attach("ns/doc", "code", "application/zip", b"\x00\x01")
+            ct, data = await store.read_attachment("ns/doc", "code")
+            assert (ct, data) == ("application/zip", b"\x00\x01")
+            await store.delete_attachments("ns/doc")
+            with pytest.raises(NoDocumentException):
+                await store.read_attachment("ns/doc", "code")
+        run(go())
+
+
+class TestEntityStore:
+    def test_typed_roundtrip_and_cache(self):
+        async def go():
+            es = EntityStore(MemoryArtifactStore())
+            a = WhiskAction(EntityPath("guest"), EntityName("hello"),
+                            CodeExec(kind="python:3", code="x"))
+            await es.put(a)
+            got = await es.get_action("guest/hello")
+            assert got.exec.code == "x"
+            assert es.cache.hits >= 1 or "guest/hello" in es.cache
+            # update with stale rev conflicts
+            b = WhiskAction(EntityPath("guest"), EntityName("hello"),
+                            CodeExec(kind="python:3", code="y"))
+            with pytest.raises(DocumentConflict):
+                await es.put(b)
+            b.rev = got.rev
+            await es.put(b)
+            got2 = await es.get_action("guest/hello")
+            assert got2.exec.code == "y"
+            await es.delete(got2)
+            with pytest.raises(NoDocumentException):
+                await es.get_action("guest/hello")
+        run(go())
+
+
+class TestAuthStore:
+    def test_identity_lookup(self):
+        async def go():
+            store = AuthStore(MemoryArtifactStore())
+            ident = Identity.generate("guest")
+            rec = WhiskAuthRecord(ident.subject, [ident.namespace], [ident.authkey])
+            await store.put(rec)
+            found = await store.identity_by_key(ident.authkey.uuid.asString,
+                                               ident.authkey.key.asString)
+            assert found is not None and found.subject == ident.subject
+            assert await store.identity_by_key(ident.authkey.uuid.asString, "wrong") is None
+            byns = await store.identity_by_namespace("guest")
+            assert byns is not None
+        run(go())
+
+
+class TestActivationStore:
+    def _activation(self, name="hello"):
+        return WhiskActivation(EntityPath("guest"), EntityName(name),
+                               Subject("guest-user"), ActivationId.generate(),
+                               start=1000.0, end=1001.0,
+                               response=ActivationResponse.success({"ok": True}),
+                               duration=1000)
+
+    def test_store_get_list(self):
+        async def go():
+            st = ArtifactActivationStore(MemoryArtifactStore())
+            acts = [self._activation() for _ in range(3)]
+            for a in acts:
+                await st.store(a)
+            got = await st.get("guest", acts[0].activation_id)
+            assert got.response.result == {"ok": True}
+            lst = await st.list("guest", limit=10)
+            assert len(lst) == 3
+            assert await st.count("guest") == 3
+            assert await st.count("guest", name="hello") == 3
+            assert await st.count("guest", name="other") == 0
+        run(go())
+
+    def test_store_respects_user_limit(self):
+        async def go():
+            st = ArtifactActivationStore(MemoryArtifactStore())
+            ident = Identity.generate("guest")
+            no_store = Identity(ident.subject, ident.namespace, ident.authkey,
+                                limits=UserLimits(store_activations=False))
+            r = await st.store(self._activation(), context=no_store)
+            assert r is None
+            assert await st.count("guest") == 0
+        run(go())
+
+
+class TestBatcher:
+    def test_coalesces(self):
+        async def go():
+            batches = []
+
+            async def op(items):
+                batches.append(list(items))
+                return [i * 2 for i in items]
+
+            b = Batcher(op, batch_size=10)
+            results = await asyncio.gather(*[b.put(i) for i in range(25)])
+            assert results == [i * 2 for i in range(25)]
+            assert all(len(x) <= 10 for x in batches)
+            assert sum(len(x) for x in batches) == 25
+            assert len(batches) < 25  # actually coalesced
+        run(go())
+
+
+class TestCacheInvalidation:
+    def test_cross_instance_eviction(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            c0, c1 = EntityCache(), EntityCache()
+            r0 = RemoteCacheInvalidation(provider, "controller0", {"whisks": c0})
+            r1 = RemoteCacheInvalidation(provider, "controller1", {"whisks": c1})
+            r0.start()
+            r1.start()
+            c0.update("guest/hello", "v0")
+            c1.update("guest/hello", "v0")
+            await r0.notify_other_instances("whisks", "guest/hello")
+            await asyncio.sleep(0.1)
+            assert "guest/hello" in c0      # own message ignored
+            assert "guest/hello" not in c1  # peer evicted
+            await r0.stop()
+            await r1.stop()
+        run(go())

@@ -1,0 +1,243 @@
+"""Entity model unit tests (mirrors reference tests/.../core/entity/test)."""
+import pytest
+
+from openwhisk_tpu.core.entity import (
+    ActionLimits, ActivationId, ActivationResponse, BasicAuthenticationAuthKey,
+    BlackBoxExec, ByteSize, CodeExec, ConcurrencyLimit, EntityName, EntityPath,
+    Exec, ExecManifest, ExecutableWhiskAction, FullyQualifiedEntityName,
+    Identity, ImageName, LimitViolation, LogLimit, MB, MemoryLimit, Parameters,
+    SemVer, SequenceExec, Subject, TimeLimit, WhiskAction, WhiskActivation,
+    WhiskPackage, WhiskRule, WhiskTrigger, ReducedRule, Binding, ACTIVE,
+)
+
+
+class TestByteSize:
+    def test_parse_and_render(self):
+        assert ByteSize.from_string("256 MB").to_mb == 256
+        assert ByteSize.from_string("1 GB").to_mb == 1024
+        assert repr(MB(256)) == "256 MB"
+        assert ByteSize.from_string("1024").bytes == 1024
+
+    def test_arithmetic_and_order(self):
+        assert MB(1) + MB(1) == MB(2)
+        assert MB(2) - MB(1) == MB(1)
+        assert MB(1) < MB(2)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            ByteSize.from_string("lots")
+
+
+class TestSemVer:
+    def test_parse_up(self):
+        v = SemVer.from_string("1.2.3")
+        assert (v.major, v.minor, v.patch) == (1, 2, 3)
+        assert repr(v.up_patch()) == "1.2.4"
+        assert repr(v.up_minor()) == "1.3.0"
+        assert repr(v.up_major()) == "2.0.0"
+
+    def test_zero_invalid(self):
+        with pytest.raises(ValueError):
+            SemVer(0, 0, 0)
+
+
+class TestActivationId:
+    def test_generate_roundtrip(self):
+        a = ActivationId.generate()
+        assert len(a.asString) == 32
+        assert ActivationId.from_json(a.to_json()) == a
+
+    def test_accepts_dashes(self):
+        a = ActivationId("aaaaaaaa-bbbb-cccc-dddd-eeeeeeeeeeee")
+        assert "-" not in a.asString
+
+    def test_rejects_bad(self):
+        with pytest.raises(ValueError):
+            ActivationId("nope")
+
+
+class TestNames:
+    def test_entity_name(self):
+        assert str(EntityName("my_action-1.x")) == "my_action-1.x"
+        with pytest.raises(ValueError):
+            EntityName("/bad")
+        with pytest.raises(ValueError):
+            EntityName("")
+
+    def test_path_resolution(self):
+        p = EntityPath("_/pkg")
+        assert p.is_default_namespace
+        assert str(p.resolve_namespace("guest")) == "guest/pkg"
+        assert str(EntityPath("ns").resolve_namespace("guest")) == "ns"
+
+    def test_fqn(self):
+        f = FullyQualifiedEntityName.parse("/ns/pkg/act")
+        assert f.namespace == "ns"
+        assert str(f) == "ns/pkg/act"
+        g = FullyQualifiedEntityName.parse("_/act").resolve("guest")
+        assert str(g) == "guest/act"
+
+
+class TestParameters:
+    def test_merge_right_bias(self):
+        a = Parameters.of(x=1, y=2)
+        b = Parameters.of(y=3, z=4)
+        m = a + b
+        assert m.to_arguments() == {"x": 1, "y": 3, "z": 4}
+
+    def test_json_roundtrip(self):
+        p = Parameters.of(key="value")
+        assert Parameters.from_json(p.to_json()) == p
+
+    def test_init_params(self):
+        from openwhisk_tpu.core.entity import ParameterValue
+        p = Parameters({"a": ParameterValue(1, init=True), "b": ParameterValue(2)})
+        assert p.init_parameters() == {"a": 1}
+
+
+class TestLimits:
+    def test_memory_bounds(self):
+        assert MemoryLimit(MB(128)).megabytes == 128
+        assert MemoryLimit().megabytes == 256
+        with pytest.raises(LimitViolation):
+            MemoryLimit(MB(64))
+        with pytest.raises(LimitViolation):
+            MemoryLimit(MB(1024))
+
+    def test_time_bounds(self):
+        assert TimeLimit().millis == 60_000
+        with pytest.raises(LimitViolation):
+            TimeLimit(10)
+        with pytest.raises(LimitViolation):
+            TimeLimit(600_000)
+
+    def test_concurrency_default_disabled(self):
+        assert ConcurrencyLimit().max_concurrent == 1
+        with pytest.raises(LimitViolation):
+            ConcurrencyLimit(2)  # MAX defaults to 1, opt-in feature
+
+    def test_limits_roundtrip(self):
+        l = ActionLimits(TimeLimit(30_000), MemoryLimit(MB(512)), LogLimit(MB(5)))
+        assert ActionLimits.from_json(l.to_json()).to_json() == l.to_json()
+
+
+class TestExec:
+    def test_code_exec_roundtrip(self):
+        e = CodeExec(kind="python:3", code="def main(args): return args")
+        j = e.to_json()
+        assert Exec.from_json(j).to_json() == j
+
+    def test_blackbox(self):
+        e = BlackBoxExec(image="you/image:latest")
+        assert e.pull
+        assert Exec.from_json(e.to_json()).image == "you/image:latest"
+
+    def test_sequence(self):
+        e = SequenceExec([FullyQualifiedEntityName.parse("ns/a"),
+                          FullyQualifiedEntityName.parse("ns/b")])
+        j = e.to_json()
+        r = Exec.from_json(j)
+        assert isinstance(r, SequenceExec)
+        assert [str(c) for c in r.components] == ["ns/a", "ns/b"]
+
+
+class TestManifest:
+    def test_image_name(self):
+        i = ImageName.from_string("registry.example.com/whisk/action-nodejs-v14:1.0")
+        assert i.registry == "registry.example.com"
+        assert i.prefix == "whisk"
+        assert i.name == "action-nodejs-v14"
+        assert i.tag == "1.0"
+        assert i.resolved == "registry.example.com/whisk/action-nodejs-v14:1.0"
+
+    def test_default_resolution_and_stemcells(self):
+        rts = ExecManifest.initialize()
+        assert rts.knows("python:3")
+        assert rts.resolve_default("python:default") == "python:3"
+        cells = rts.stem_cells()
+        assert any(s.count == 2 and s.memory.to_mb == 256 for _, s in cells)
+
+
+class TestActionEntity:
+    def _action(self):
+        return WhiskAction(EntityPath("guest"), EntityName("hello"),
+                           CodeExec(kind="python:3", code="def main(a): return a"))
+
+    def test_roundtrip(self):
+        a = self._action()
+        j = a.to_json()
+        b = WhiskAction.from_json(j)
+        assert b.docid == "guest/hello"
+        assert b.exec.kind == "python:3"
+        assert b.limits.memory.megabytes == 256
+
+    def test_executable_projection(self):
+        a = self._action()
+        ex = a.to_executable()
+        assert isinstance(ex, ExecutableWhiskAction)
+        init = ex.container_initializer()
+        assert init["code"].startswith("def main")
+        seq = WhiskAction(EntityPath("guest"), EntityName("s"),
+                          SequenceExec([FullyQualifiedEntityName.parse("g/a")]))
+        assert seq.to_executable() is None
+        assert seq.is_sequence
+
+
+class TestActivationEntity:
+    def test_response_kinds(self):
+        assert ActivationResponse.success({"ok": 1}).is_success
+        assert ActivationResponse.application_error("boom").is_app_error
+        assert ActivationResponse.whisk_error("x").is_whisk_error
+        assert ActivationResponse.developer_error("x").status == "action developer error"
+
+    def test_shrink(self):
+        big = ActivationResponse.success({"d": "x" * 100})
+        shrunk = big.shrink(10)
+        assert shrunk.result is None and shrunk.size is not None
+        small = ActivationResponse.success({"d": "x"})
+        assert small.shrink(1000).result == {"d": "x"}
+
+    def test_roundtrip(self):
+        act = WhiskActivation(EntityPath("guest"), EntityName("hello"),
+                              Subject("guest-user"), ActivationId.generate(),
+                              start=100.0, end=101.0,
+                              response=ActivationResponse.success({"r": 1}),
+                              logs=["l1"], duration=1000)
+        j = act.to_json()
+        b = WhiskActivation.from_json(j)
+        assert b.activation_id == act.activation_id
+        assert b.response.result == {"r": 1}
+        assert b.duration == 1000
+
+
+class TestTriggerRulePackage:
+    def test_trigger_rules(self):
+        t = WhiskTrigger(EntityPath("guest"), EntityName("t"))
+        t.add_rule("guest/r", ReducedRule(FullyQualifiedEntityName.parse("guest/a")))
+        j = t.to_json()
+        b = WhiskTrigger.from_json(j)
+        assert b.rules["guest/r"].status == ACTIVE
+
+    def test_rule_roundtrip(self):
+        r = WhiskRule(EntityPath("guest"), EntityName("r"),
+                      FullyQualifiedEntityName.parse("guest/t"),
+                      FullyQualifiedEntityName.parse("guest/a"))
+        assert WhiskRule.from_json(r.to_json()).action.name.name == "a"
+
+    def test_package_binding(self):
+        p = WhiskPackage(EntityPath("guest"), EntityName("pkg"),
+                         parameters=Parameters.of(a=1))
+        assert not p.is_binding
+        b = WhiskPackage(EntityPath("guest"), EntityName("bnd"),
+                         binding=Binding(EntityPath("other"), EntityName("pkg")))
+        assert b.is_binding
+        assert WhiskPackage.from_json(b.to_json()).binding.fqn.namespace == "other"
+
+
+class TestIdentity:
+    def test_generate_and_auth(self):
+        i = Identity.generate("guest")
+        parsed = BasicAuthenticationAuthKey.parse(i.authkey.compact)
+        assert parsed == i.authkey
+        j = i.to_json()
+        assert Identity.from_json(j).namespace.name.name == "guest"

@@ -1,0 +1,186 @@
+"""Messaging tests (mirrors reference MessageFeedTests + TestConnector use)."""
+import asyncio
+import json
+
+import pytest
+
+from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                       EntityName, EntityPath,
+                                       FullyQualifiedEntityName, Identity,
+                                       InvokerInstanceId, Subject,
+                                       ActivationResponse, WhiskActivation)
+from openwhisk_tpu.messaging import (ActivationMessage,
+                                     CombinedCompletionAndResultMessage,
+                                     CompletionMessage, MemoryMessagingProvider,
+                                     MessageFeed, PingMessage, ResultMessage,
+                                     parse_ack)
+from openwhisk_tpu.utils.transaction import TransactionId
+
+
+def _identity():
+    return Identity.generate("guest")
+
+
+def _activation_message(blocking=True):
+    return ActivationMessage(
+        TransactionId(), FullyQualifiedEntityName.parse("guest/hello"),
+        "1-abc", _identity(), ActivationId.generate(),
+        ControllerInstanceId("0"), blocking, {"payload": "x"})
+
+
+class TestMessageSerde:
+    def test_activation_message_roundtrip(self):
+        m = _activation_message()
+        r = ActivationMessage.parse(m.serialize())
+        assert r.activation_id == m.activation_id
+        assert str(r.action) == "guest/hello"
+        assert r.blocking
+        assert r.content == {"payload": "x"}
+
+    def test_ack_roundtrips(self):
+        act = WhiskActivation(EntityPath("guest"), EntityName("hello"),
+                              Subject("guest-user"), ActivationId.generate(),
+                              1.0, 2.0, ActivationResponse.success({"a": 1}))
+        inv = InvokerInstanceId(3)
+        for msg in (CompletionMessage(TransactionId(), act.activation_id, False, inv),
+                    ResultMessage(TransactionId(), act),
+                    CombinedCompletionAndResultMessage(TransactionId(), act, inv)):
+            r = parse_ack(msg.serialize())
+            assert type(r) is type(msg)
+            assert r.activation_id == act.activation_id
+        c = parse_ack(CombinedCompletionAndResultMessage(TransactionId(), act, inv).serialize())
+        assert c.is_slot_free and c.invoker.instance == 3
+        assert c.activation.response.result == {"a": 1}
+        res = parse_ack(ResultMessage(TransactionId(), act).serialize())
+        assert not res.is_slot_free
+
+    def test_ping(self):
+        p = PingMessage.parse(PingMessage(InvokerInstanceId(7)).serialize())
+        assert p.instance.instance == 7
+
+
+class TestMemoryBus:
+    def test_produce_consume(self):
+        async def run():
+            prov = MemoryMessagingProvider()
+            prod = prov.get_producer()
+            cons = prov.get_consumer("t", "g")
+            await prod.send("t", b"m1")
+            await prod.send("t", b"m2")
+            batch = await cons.peek(10)
+            cons.commit()
+            return [p for (_, _, _, p) in batch]
+
+        assert asyncio.run(run()) == [b"m1", b"m2"]
+
+    def test_messages_before_subscribe_are_retained(self):
+        async def run():
+            prov = MemoryMessagingProvider()
+            prod = prov.get_producer()
+            await prod.send("t", b"early")
+            cons = prov.get_consumer("t", "g")
+            batch = await cons.peek(10)
+            return [p for (_, _, _, p) in batch]
+
+        assert asyncio.run(run()) == [b"early"]
+
+    def test_competing_consumers_split_messages(self):
+        async def run():
+            prov = MemoryMessagingProvider()
+            prod = prov.get_producer()
+            c1 = prov.get_consumer("t", "g")
+            c2 = prov.get_consumer("t", "g")
+            for i in range(4):
+                await prod.send("t", f"m{i}".encode())
+            b1 = await c1.peek(2)
+            b2 = await c2.peek(2)
+            return len(b1) + len(b2)
+
+        assert asyncio.run(run()) == 4
+
+
+class TestMessageFeed:
+    def test_backpressure_and_delivery(self):
+        async def run():
+            prov = MemoryMessagingProvider()
+            prod = prov.get_producer()
+            cons = prov.get_consumer("activations", "invoker0")
+            received = []
+            feeds = {}
+
+            async def handler(payload: bytes):
+                received.append(payload)
+                # simulate async completion later
+                async def done():
+                    await asyncio.sleep(0.01)
+                    feeds["f"].processed()
+                asyncio.get_event_loop().create_task(done())
+
+            feed = MessageFeed("test", cons, maximum_handler_capacity=2,
+                               handler=handler, long_poll_timeout=0.05)
+            feeds["f"] = feed
+            feed.start()
+            for i in range(6):
+                await prod.send("activations", f"m{i}".encode())
+            await asyncio.sleep(0.3)
+            await feed.stop()
+            return received
+
+        received = asyncio.run(run())
+        assert received == [f"m{i}".encode() for i in range(6)]
+
+    def test_capacity_limits_inflight(self):
+        async def run():
+            prov = MemoryMessagingProvider()
+            prod = prov.get_producer()
+            cons = prov.get_consumer("t", "g")
+            inflight = {"now": 0, "max": 0}
+            feeds = {}
+
+            async def handler(payload: bytes):
+                inflight["now"] += 1
+                inflight["max"] = max(inflight["max"], inflight["now"])
+
+                async def done():
+                    await asyncio.sleep(0.02)
+                    inflight["now"] -= 1
+                    feeds["f"].processed()
+                asyncio.get_event_loop().create_task(done())
+
+            feed = MessageFeed("test", cons, maximum_handler_capacity=3,
+                               handler=handler, long_poll_timeout=0.05)
+            feeds["f"] = feed
+            feed.start()
+            for i in range(12):
+                await prod.send("t", f"m{i}".encode())
+            await asyncio.sleep(0.4)
+            await feed.stop()
+            return inflight["max"]
+
+        assert asyncio.run(run()) <= 3
+
+    def test_handler_error_does_not_kill_feed(self):
+        async def run():
+            prov = MemoryMessagingProvider()
+            prod = prov.get_producer()
+            cons = prov.get_consumer("t", "g")
+            good = []
+            feeds = {}
+
+            async def handler(payload: bytes):
+                if payload == b"bad":
+                    raise RuntimeError("boom")
+                good.append(payload)
+                feeds["f"].processed()
+
+            feed = MessageFeed("test", cons, maximum_handler_capacity=2,
+                               handler=handler, long_poll_timeout=0.05)
+            feeds["f"] = feed
+            feed.start()
+            await prod.send("t", b"bad")
+            await prod.send("t", b"ok")
+            await asyncio.sleep(0.2)
+            await feed.stop()
+            return good
+
+        assert asyncio.run(run()) == [b"ok"]

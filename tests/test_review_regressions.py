@@ -1,0 +1,69 @@
+"""Regression tests for review findings (sqlite :memory: threading, LIKE
+wildcard escaping, ack shrink aliasing, feed capacity double-credit)."""
+import asyncio
+
+import pytest
+
+from openwhisk_tpu.core.entity import (ActivationId, ActivationResponse,
+                                       EntityName, EntityPath, Subject,
+                                       WhiskActivation)
+from openwhisk_tpu.database import SqliteArtifactStore
+from openwhisk_tpu.database.cache import EntityCache, RemoteCacheInvalidation
+from openwhisk_tpu.messaging import MemoryMessagingProvider, ResultMessage, parse_ack
+from openwhisk_tpu.utils.transaction import TransactionId
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_sqlite_memory_store_works_across_executor_threads():
+    async def go():
+        st = SqliteArtifactStore()  # :memory:
+        await st.put("ns/a", {"entityType": "actions", "namespace": "ns",
+                              "name": "a", "updated": 1})
+        return await st.get("ns/a")
+    assert run(go())["name"] == "a"
+
+
+def test_sqlite_namespace_underscore_not_wildcard():
+    async def go():
+        st = SqliteArtifactStore()
+        await st.put("my_ns/a", {"entityType": "actions", "namespace": "my_ns",
+                                 "name": "a", "updated": 1})
+        await st.put("myxns/pkg/b", {"entityType": "actions", "namespace": "myxns/pkg",
+                                     "name": "b", "updated": 2})
+        docs = await st.query("actions", "my_ns")
+        count = await st.count("actions", "my_ns")
+        return [d["name"] for d in docs], count
+    names, count = run(go())
+    assert names == ["a"]
+    assert count == 1
+
+
+def test_ack_shrink_does_not_mutate_stored_activation():
+    act = WhiskActivation(EntityPath("guest"), EntityName("big"),
+                          Subject("guest-user"), ActivationId.generate(),
+                          1.0, 2.0, ActivationResponse.success({"blob": "x" * 100}))
+    msg = ResultMessage(TransactionId(), act)
+    shrunk = msg.shrink(10)
+    assert act.response.result == {"blob": "x" * 100}  # original intact
+    parsed = parse_ack(shrunk.serialize())
+    assert parsed.activation.response.result is None
+    assert parsed.activation.response.size is not None
+
+
+def test_invalidation_feed_capacity_not_inflated_by_bad_payloads():
+    async def go():
+        provider = MemoryMessagingProvider()
+        c = EntityCache()
+        r = RemoteCacheInvalidation(provider, "c0", {"whisks": c})
+        r.start()
+        prod = provider.get_producer()
+        for _ in range(5):
+            await prod.send("cacheInvalidation", b"not json")
+        await asyncio.sleep(0.1)
+        free = r._feed.free_capacity
+        await r.stop()
+        return free
+    assert run(go()) <= 128

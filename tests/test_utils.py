@@ -1,0 +1,150 @@
+"""Utils tests: semaphores (ref NestedSemaphoreTests/ForcibleSemaphoreTests),
+ring buffer, config, SPI registry."""
+import asyncio
+import dataclasses
+
+import pytest
+
+from openwhisk_tpu import spi
+from openwhisk_tpu.utils import (ForcibleSemaphore, NestedSemaphore,
+                                 ResizableSemaphore, RingBuffer, Scheduler)
+from openwhisk_tpu.utils.config import (config_from_env, load_config,
+                                        require_properties,
+                                        RequiredPropertiesError)
+
+
+class TestForcibleSemaphore:
+    def test_try_acquire(self):
+        s = ForcibleSemaphore(2)
+        assert s.try_acquire()
+        assert s.try_acquire()
+        assert not s.try_acquire()
+        s.release()
+        assert s.try_acquire()
+
+    def test_force_overcommit(self):
+        s = ForcibleSemaphore(1)
+        assert s.try_acquire()
+        s.force_acquire()
+        assert s.available_permits == -1
+        s.release()
+        s.release()
+        assert s.available_permits == 1
+
+
+class TestNestedSemaphore:
+    def test_plain_memory_when_concurrency_1(self):
+        s = NestedSemaphore(256)
+        assert s.try_acquire_concurrent("a", 1, 256)
+        assert not s.try_acquire_concurrent("a", 1, 1)
+        s.release_concurrent("a", 1, 256)
+        assert s.available_permits == 256
+
+    def test_concurrent_slots_reuse_memory(self):
+        # One 128MB container with maxConcurrent=4 serves 4 activations on
+        # one memory acquisition (ref NestedSemaphore.scala semantics).
+        s = NestedSemaphore(128)
+        for _ in range(4):
+            assert s.try_acquire_concurrent("act", 4, 128)
+        assert s.available_permits == 0
+        # 5th needs a new container -> no memory -> fail
+        assert not s.try_acquire_concurrent("act", 4, 128)
+        # release all 4 -> container idle -> memory released
+        for _ in range(4):
+            s.release_concurrent("act", 4, 128)
+        assert s.available_permits == 128
+        assert s.concurrent_slots_available("act") == 0
+
+    def test_force_concurrent(self):
+        s = NestedSemaphore(64)
+        s.force_acquire_concurrent("a", 2, 128)
+        assert s.available_permits == 64 - 128
+        # the forced container still minted a spare slot
+        assert s.try_acquire_concurrent("a", 2, 128)
+
+    def test_two_containers(self):
+        s = NestedSemaphore(256)
+        for _ in range(6):
+            assert s.try_acquire_concurrent("a", 3, 128)
+        assert s.available_permits == 0  # two containers of 128
+        for _ in range(3):
+            s.release_concurrent("a", 3, 128)
+        assert s.available_permits == 128
+
+
+class TestRingBuffer:
+    def test_window(self):
+        r = RingBuffer(3)
+        for i in range(5):
+            r.add(i)
+        assert r.to_list() == [2, 3, 4]
+        assert r.count(lambda x: x > 2) == 2
+
+
+@dataclasses.dataclass(frozen=True)
+class _Inner:
+    retries: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class _Cfg:
+    host: str = "localhost"
+    port: int = 8080
+    verbose: bool = False
+    inner: _Inner = dataclasses.field(default_factory=_Inner)
+
+
+class TestConfig:
+    def test_load_defaults_and_overrides(self):
+        c = load_config(_Cfg, {"port": "9090", "inner": {"retries": 5}})
+        assert c.port == 9090
+        assert c.inner.retries == 5
+        assert c.host == "localhost"
+
+    def test_env_collection(self):
+        env = {"CONFIG_whisk_loadBalancer_timeoutFactor": "2",
+               "CONFIG_whisk_loadBalancer_enabled": "true"}
+        d = config_from_env(environ=env)
+        assert d["load_balancer"]["timeout_factor"] == "2"
+        assert d["load_balancer"]["enabled"] == "true"
+
+    def test_required_properties(self):
+        with pytest.raises(RequiredPropertiesError):
+            require_properties({"kafka.host": None})
+        assert require_properties({"a": "1"}) == {"a": "1"}
+
+
+class TestSpi:
+    def test_default_resolution(self):
+        impl = spi.get("MessagingProvider")
+        assert impl is not None
+
+    def test_bind_and_reset(self):
+        sentinel = object()
+        spi.bind("MessagingProvider", sentinel)
+        assert spi.get("MessagingProvider") is sentinel
+        spi.reset()
+        assert spi.get("MessagingProvider") is not sentinel
+
+    def test_unknown(self):
+        with pytest.raises(spi.SpiResolutionError):
+            spi.get("NotAnSpi")
+
+
+class TestScheduler:
+    def test_repeats_and_survives_errors(self):
+        async def run():
+            calls = []
+
+            def work():
+                calls.append(1)
+                if len(calls) == 1:
+                    raise RuntimeError("transient")
+
+            s = Scheduler(0.01, work, name="t").start()
+            await asyncio.sleep(0.08)
+            await s.stop()
+            return calls
+
+        calls = asyncio.run(run())
+        assert len(calls) >= 3
