@@ -1,0 +1,11 @@
+from .container import (Container, ContainerError, InitializationError,
+                        RunResult, ACTIVATION_LOG_SENTINEL)
+from .factory import ContainerFactory, ContainerPoolConfig
+from .process_factory import (ProcessContainer, ProcessContainerFactory,
+                              ProcessContainerFactoryProvider)
+from .docker_factory import DockerContainerFactory, docker_available
+from .pool import ContainerPool, Run
+from .proxy import ContainerProxy, ContainerData
+from .logstore import ContainerLogStore, ContainerLogStoreProvider
+
+__all__ = [n for n in dir() if not n.startswith("_")]

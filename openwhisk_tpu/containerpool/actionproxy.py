@@ -1,0 +1,129 @@
+"""Action proxy: the HTTP server inside an action "container".
+
+This is the framework's equivalent of the runtime images' proxy (the contract
+is documented by the reference's tools/actionProxy/invoke.py and
+docs/actions-new.md): POST /init receives {"value": {code, main, binary,
+env}}; POST /run receives {"value": args, ...activation context} and must
+return the action result as JSON. After every /run the proxy prints the log
+sentinel to stdout and stderr so the log collector can frame per-activation
+logs.
+
+Runs standalone: `python -m openwhisk_tpu.containerpool.actionproxy <port>`.
+Kept dependency-free (stdlib only) so it can be dropped into any image.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import traceback
+from contextlib import redirect_stderr, redirect_stdout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+SENTINEL = "XXX_THE_END_OF_A_WHISK_ACTIVATION_XXX"
+
+_state = {"fn": None, "env": {}}
+
+
+def _compile_action(code: str, main: str):
+    scope: dict = {}
+    exec(compile(code, "<action>", "exec"), scope)  # noqa: S102 — this IS the sandbox body
+    fn = scope.get(main)
+    if not callable(fn):
+        raise ValueError(f"Initialization has failed: no callable {main!r}")
+    return fn
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _reply(self, status: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _read_json(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return {}
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        if self.path == "/init":
+            self._init(self._read_json())
+        elif self.path == "/run":
+            self._run(self._read_json())
+        else:
+            self._reply(404, {"error": "unknown path"})
+
+    def do_GET(self):  # noqa: N802
+        self._reply(200 if _state["fn"] else 503, {"ok": _state["fn"] is not None})
+
+    def _init(self, payload: dict) -> None:
+        value = payload.get("value", {})
+        code = value.get("code", "")
+        main = value.get("main") or "main"
+        if value.get("binary"):
+            self._reply(502, {"error": "binary python actions are not supported by this proxy"})
+            return
+        try:
+            _state["fn"] = _compile_action(code, main)
+            _state["env"] = value.get("env") or {}
+            # export the init environment (e.g. __OW_API_KEY) so user code
+            # can read it via os.environ, as in the real runtime images
+            for k, v in _state["env"].items():
+                os.environ[str(k)] = str(v)
+            self._reply(200, {"ok": True})
+        except Exception as e:  # noqa: BLE001 — report any user-code failure
+            self._reply(502, {"error": f"Initialization has failed: {e}"})
+
+    def _run(self, payload: dict) -> None:
+        if _state["fn"] is None:
+            self._reply(502, {"error": "cannot invoke an uninitialized action"})
+            return
+        args = payload.get("value") or {}
+        # activation context -> env vars, as the runtime containers do
+        for k, v in payload.items():
+            if k != "value" and isinstance(v, str):
+                os.environ["__OW_" + k.upper()] = v
+        out, err = io.StringIO(), io.StringIO()
+        try:
+            with redirect_stdout(out), redirect_stderr(err):
+                result = _state["fn"](args)
+            if result is None:
+                result = {}
+            if not isinstance(result, dict):
+                self._reply(502, {"error": "the action did not return a dictionary"})
+            else:
+                self._reply(200, result)
+        except Exception:  # noqa: BLE001 — user code error -> application error
+            err.write(traceback.format_exc())
+            self._reply(502, {"error": "An error has occurred while running the action."})
+        finally:
+            # relay user logs + sentinel framing to the real stdout/stderr
+            sys.stdout.write(out.getvalue())
+            sys.stdout.write(SENTINEL + "\n")
+            sys.stdout.flush()
+            sys.stderr.write(err.getvalue())
+            sys.stderr.write(SENTINEL + "\n")
+            sys.stderr.flush()
+
+
+def main() -> None:
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    print(f"action proxy listening on {port}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""LogStore SPI: per-activation log collection.
+
+Rebuild of common/scala/.../core/containerpool/logging/ — the default store
+reads the container's framed stdout/stderr (sentinel-delimited) straight into
+the activation record (DockerToActivationLogStore); a file-sink variant
+appends to a newline-JSON log file for out-of-band shipping
+(DockerToActivationFileLogStore).
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+class ContainerLogStore:
+    """Collect logs from the container into the activation record."""
+
+    def __init__(self, log_file_path: Optional[str] = None):
+        self.log_file_path = log_file_path
+
+    async def collect_logs(self, transid, user, activation, container, action) -> List[str]:
+        limit = action.limits.logs.size.bytes
+        if limit <= 0:
+            return []
+        lines = await container.logs(limit_bytes=limit, wait_for_sentinel=True)
+        if self.log_file_path:
+            self._sink(user, activation, lines)
+        return lines
+
+    def _sink(self, user, activation, lines: List[str]) -> None:
+        with open(self.log_file_path, "a") as f:
+            for line in lines:
+                f.write(json.dumps({
+                    "activationId": activation.activation_id.asString,
+                    "namespace": str(activation.namespace),
+                    "action": str(activation.name),
+                    "message": line,
+                }) + "\n")
+
+
+class ContainerLogStoreProvider:
+    @staticmethod
+    def instance(log_file_path: Optional[str] = None) -> ContainerLogStore:
+        return ContainerLogStore(log_file_path)

@@ -1,0 +1,515 @@
+"""Controller REST API: /api/v1 route tree.
+
+Rebuild of core/controller/.../controller/RestAPIs.scala:160-228 (versioned
+route tree + auth directive) with the per-collection APIs:
+  Actions.scala      CRUD + invoke (?blocking, ?result, ?timeout)
+  Activations.scala  list/get/logs/result
+  Namespaces.scala   namespace listing
+  Triggers.scala     CRUD + fire (direct internal rule dispatch, not the
+                     reference's HTTP loopback — Triggers.scala:390-412)
+  Rules.scala        CRUD + status
+  Packages.scala     CRUD incl. bindings
+JSON wire shapes follow the reference so `wsk`-style clients port over.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ..core.entity import (ACTIVE, ActivationId, Binding, ConcurrencyLimit,
+                           EntityName, EntityPath, Exec, ExecManifest,
+                           Identity, LimitViolation, LogLimit, MB, MemoryLimit,
+                           Parameters, ReducedRule, SemVer, SequenceExec,
+                           TimeLimit, WhiskAction, WhiskActivation, WhiskPackage,
+                           WhiskRule, WhiskTrigger)
+from ..core.entity.action import ActionLimits
+from ..core.entity.names import FullyQualifiedEntityName
+from ..database import DocumentConflict, NoDocumentException
+from ..utils.transaction import TransactionId
+from .entitlement import (ACTIVATE, DELETE, EntitlementException, PUT, READ,
+                          ThrottleRejectRequest)
+from .invoke import resolve_action
+
+MAX_LIST_LIMIT = 200
+
+
+def _error(status: int, message: str, transid: Optional[TransactionId] = None
+           ) -> web.Response:
+    return web.json_response({"error": message,
+                              "code": transid.id if transid else None},
+                             status=status)
+
+
+class ControllerApi:
+    def __init__(self, controller):
+        """`controller` is openwhisk_tpu.controller.core.Controller."""
+        self.c = controller
+
+    # ------------------------------------------------------------------ app
+    def make_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._auth_middleware])
+        r = app.router
+        r.add_get("/ping", self.ping)
+        r.add_get("/api/v1", self.api_info)
+        r.add_get("/api/v1/namespaces", self.list_namespaces)
+        base = "/api/v1/namespaces/{ns}"
+        # actions (name may contain a package segment)
+        r.add_get(base + "/actions", self.list_actions)
+        r.add_route("*", base + "/actions/{name:[^/]+(?:/[^/]+)?}", self.action_entry)
+        # activations
+        r.add_get(base + "/activations", self.list_activations)
+        r.add_get(base + "/activations/{id}", self.get_activation)
+        r.add_get(base + "/activations/{id}/logs", self.get_activation_logs)
+        r.add_get(base + "/activations/{id}/result", self.get_activation_result)
+        # triggers
+        r.add_get(base + "/triggers", self.list_triggers)
+        r.add_route("*", base + "/triggers/{name}", self.trigger_entry)
+        # rules
+        r.add_get(base + "/rules", self.list_rules)
+        r.add_route("*", base + "/rules/{name}", self.rule_entry)
+        # packages
+        r.add_get(base + "/packages", self.list_packages)
+        r.add_route("*", base + "/packages/{name}", self.package_entry)
+        # web actions (anonymous)
+        r.add_route("*", "/api/v1/web/{ns}/{pkg}/{name:.+}", self.web_action)
+        # system
+        r.add_get("/invokers", self.invokers)
+        r.add_get("/metrics", self.metrics)
+        return app
+
+    # ----------------------------------------------------------- middleware
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        if request.path in ("/ping", "/api/v1", "/metrics") or \
+                request.path.startswith("/api/v1/web/"):
+            return await handler(request)
+        identity = await self.c.authenticator.identity_from_header(
+            request.headers.get("Authorization"))
+        if identity is None:
+            return _error(401, "The supplied authentication is invalid.")
+        request["identity"] = identity
+        request["transid"] = TransactionId()
+        try:
+            return await handler(request)
+        except EntitlementException as e:
+            return _error(e.status, e.message, request.get("transid"))
+        except NoDocumentException:
+            return _error(404, "The requested resource does not exist.",
+                          request.get("transid"))
+        except DocumentConflict:
+            return _error(409, "Concurrent modification to resource detected.",
+                          request.get("transid"))
+        except LimitViolation as e:
+            return _error(400, str(e), request.get("transid"))
+        except (json.JSONDecodeError, ValueError) as e:
+            return _error(400, f"malformed request: {e}", request.get("transid"))
+        except KeyError as e:
+            return _error(400, f"missing required field: {e}", request.get("transid"))
+
+    # -------------------------------------------------------------- helpers
+    def _namespace(self, request: web.Request) -> str:
+        ns = request.match_info["ns"]
+        identity: Identity = request["identity"]
+        return str(identity.namespace.name) if ns == "_" else ns
+
+    async def _check(self, request, right, namespace, throttle=False,
+                     is_trigger_fire=False):
+        await self.c.entitlement.check(request["identity"], right, namespace,
+                                       throttle=throttle,
+                                       is_trigger_fire=is_trigger_fire)
+
+    @staticmethod
+    def _list_params(request):
+        try:
+            limit = min(int(request.query.get("limit", 30)), MAX_LIST_LIMIT)
+            skip = int(request.query.get("skip", 0))
+        except ValueError:
+            raise LimitViolation("limit/skip must be integers") from None
+        return max(0, limit), max(0, skip)
+
+    @staticmethod
+    def _bool_param(request, name: str) -> bool:
+        v = request.query.get(name, "false").lower()
+        return v in ("true", "1", "yes", "")
+
+    # ---------------------------------------------------------------- misc
+    async def ping(self, request):
+        return web.json_response("pong")
+
+    async def api_info(self, request):
+        return web.json_response({
+            "description": "OpenWhisk-TPU", "api_version": "1.0.0",
+            "api_paths": ["/api/v1"], "runtimes": ExecManifest.runtimes().kinds,
+            "limits": {
+                "actions_per_minute": self.c.entitlement.invoke_rate.default_per_minute,
+                "concurrent_actions": self.c.entitlement.concurrent.default_concurrent,
+                "triggers_per_minute": self.c.entitlement.fire_rate.default_per_minute,
+                "max_action_duration": TimeLimit.MAX_MS,
+                "max_action_memory": MemoryLimit.MAX.bytes,
+                "min_action_duration": TimeLimit.MIN_MS,
+                "min_action_memory": MemoryLimit.MIN.bytes,
+            }})
+
+    async def invokers(self, request):
+        health = await self.c.load_balancer.invoker_health()
+        return web.json_response({h.id.as_string: h.status for h in health})
+
+    async def metrics(self, request):
+        return web.Response(text=self.c.metrics.prometheus_text(),
+                            content_type="text/plain")
+
+    async def list_namespaces(self, request):
+        identity: Identity = request["identity"]
+        return web.json_response([str(identity.namespace.name)])
+
+    # -------------------------------------------------------------- actions
+    async def list_actions(self, request):
+        ns = self._namespace(request)
+        await self._check(request, READ, ns)
+        limit, skip = self._list_params(request)
+        docs = await self.c.entity_store.list("actions", ns, skip, limit)
+        return web.json_response([self._summary(d) for d in docs])
+
+    @staticmethod
+    def _summary(doc: dict) -> dict:
+        out = {k: doc.get(k) for k in
+               ("namespace", "name", "version", "publish", "annotations", "updated")}
+        if doc.get("entityType") == "actions":
+            exec_meta = {k: v for k, v in (doc.get("exec") or {}).items() if k != "code"}
+            out["exec"] = exec_meta
+            out["limits"] = doc.get("limits")
+        if doc.get("entityType") == "rules":
+            out["trigger"] = doc.get("trigger")
+            out["action"] = doc.get("action")
+        if doc.get("entityType") == "packages":
+            out["binding"] = doc.get("binding") or {}
+        return out
+
+    async def action_entry(self, request):
+        ns = self._namespace(request)
+        name = request.match_info["name"]
+        fqn = FullyQualifiedEntityName.parse(f"{ns}/{name}")
+        if request.method == "PUT":
+            return await self._put_action(request, ns, fqn)
+        if request.method == "GET":
+            return await self._get_action(request, ns, fqn)
+        if request.method == "DELETE":
+            return await self._delete_action(request, ns, fqn)
+        if request.method == "POST":
+            return await self._invoke_action(request, ns, fqn)
+        return _error(405, "method not allowed")
+
+    async def _put_action(self, request, ns, fqn):
+        await self._check(request, PUT, ns)
+        overwrite = self._bool_param(request, "overwrite")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "malformed JSON body", request["transid"])
+        if "exec" not in body:
+            return _error(400, "exec undefined", request["transid"])
+        exec_ = Exec.from_json(body["exec"])
+        if exec_.kind not in ("sequence", "blackbox"):
+            resolved = ExecManifest.runtimes().resolve_default(exec_.kind)
+            if not ExecManifest.runtimes().knows(resolved):
+                return _error(
+                    400, f"kind '{exec_.kind}' not in Set({', '.join(ExecManifest.runtimes().kinds)})",
+                    request["transid"])
+            exec_.kind = resolved
+            self.c.entitlement.check_kind(request["identity"], exec_.kind)
+        if isinstance(exec_, SequenceExec):
+            exec_.components = [c.resolve(ns) for c in exec_.components]
+            if len(exec_.components) > self.c.action_sequence_limit:
+                raise LimitViolation("too many actions in the sequence")
+        action = WhiskAction(
+            fqn.path if not fqn.path.default_package else EntityPath(ns),
+            fqn.name if isinstance(fqn.name, EntityName) else EntityName(str(fqn.name)),
+            exec_,
+            Parameters.from_json(body.get("parameters")),
+            ActionLimits.from_json(body.get("limits")),
+            publish=bool(body.get("publish", False)),
+            annotations=Parameters.from_json(body.get("annotations")),
+        )
+        # correct namespace for packaged actions: ns/pkg
+        action.namespace = fqn.path
+        try:
+            old = await self.c.entity_store.get_action(str(fqn))
+            if not overwrite:
+                return _error(409, "resource already exists", request["transid"])
+            action.version = old.version.up_patch()
+            action.rev = old.rev
+        except NoDocumentException:
+            pass
+        await self.c.entity_store.put(action)
+        return web.json_response(action.to_json())
+
+    async def _get_action(self, request, ns, fqn):
+        await self._check(request, READ, ns)
+        action, _ = await resolve_action(self.c.entity_store, fqn, request["identity"])
+        j = action.to_json()
+        if request.query.get("code", "true").lower() == "false" and "exec" in j:
+            j["exec"].pop("code", None)
+        return web.json_response(j)
+
+    async def _delete_action(self, request, ns, fqn):
+        await self._check(request, DELETE, ns)
+        action = await self.c.entity_store.get_action(str(fqn))
+        await self.c.entity_store.delete(action)
+        return web.json_response(action.to_json())
+
+    async def _invoke_action(self, request, ns, fqn):
+        await self._check(request, ACTIVATE, ns, throttle=True)
+        blocking = self._bool_param(request, "blocking")
+        result_only = self._bool_param(request, "result")
+        try:
+            wait_override = float(request.query["timeout"]) / 1000.0 \
+                if "timeout" in request.query else None
+        except ValueError:
+            wait_override = None
+        try:
+            payload = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            return _error(400, "malformed JSON body", request["transid"])
+        action, pkg_params = await resolve_action(self.c.entity_store, fqn,
+                                                  request["identity"])
+        if action.is_sequence:
+            outcome = await self.c.sequencer.invoke_sequence(
+                request["identity"], action, payload, blocking,
+                transid=request["transid"])
+        else:
+            outcome = await self.c.invoker.invoke(
+                request["identity"], action, pkg_params, payload, blocking,
+                transid=request["transid"], wait_override=wait_override)
+        if outcome.accepted:
+            return web.json_response(
+                {"activationId": outcome.activation_id.asString}, status=202)
+        activation = outcome.activation
+        if result_only:
+            status = 200 if activation.response.is_success else 502
+            return web.json_response(activation.resulting_json(), status=status)
+        status = 200 if activation.response.is_success else 502
+        return web.json_response(activation.to_json(), status=status)
+
+    # ---------------------------------------------------------- activations
+    async def list_activations(self, request):
+        ns = self._namespace(request)
+        await self._check(request, READ, ns)
+        limit, skip = self._list_params(request)
+        name = request.query.get("name")
+        since = float(request.query["since"]) / 1000 if "since" in request.query else None
+        upto = float(request.query["upto"]) / 1000 if "upto" in request.query else None
+        if self._bool_param(request, "count"):
+            n = await self.c.activation_store.count(ns, name, since, upto)
+            return web.json_response({"activations": n})
+        docs = await self.c.activation_store.list(ns, name, skip, limit, since, upto)
+        summaries = [WhiskActivation.from_json(d).summary_json() for d in docs]
+        return web.json_response(summaries)
+
+    async def _activation(self, request) -> WhiskActivation:
+        ns = self._namespace(request)
+        await self._check(request, READ, ns)
+        try:
+            aid = ActivationId(request.match_info["id"])
+        except ValueError:
+            raise NoDocumentException("malformed activation id") from None
+        return await self.c.activation_store.get(ns, aid)
+
+    async def get_activation(self, request):
+        return web.json_response((await self._activation(request)).to_json())
+
+    async def get_activation_logs(self, request):
+        a = await self._activation(request)
+        return web.json_response({"logs": a.logs})
+
+    async def get_activation_result(self, request):
+        a = await self._activation(request)
+        return web.json_response({"result": a.response.result,
+                                  "status": a.response.status,
+                                  "success": a.response.is_success})
+
+    # -------------------------------------------------------------- triggers
+    async def list_triggers(self, request):
+        ns = self._namespace(request)
+        await self._check(request, READ, ns)
+        limit, skip = self._list_params(request)
+        docs = await self.c.entity_store.list("triggers", ns, skip, limit)
+        return web.json_response([self._summary(d) for d in docs])
+
+    async def trigger_entry(self, request):
+        ns = self._namespace(request)
+        name = request.match_info["name"]
+        doc_id = f"{ns}/{name}"
+        if request.method == "PUT":
+            await self._check(request, PUT, ns)
+            overwrite = self._bool_param(request, "overwrite")
+            body = await request.json() if request.can_read_body else {}
+            trigger = WhiskTrigger(EntityPath(ns), EntityName(name),
+                                   Parameters.from_json(body.get("parameters")),
+                                   annotations=Parameters.from_json(body.get("annotations")),
+                                   publish=bool(body.get("publish", False)))
+            try:
+                old = await self.c.entity_store.get_trigger(doc_id)
+                if not overwrite:
+                    return _error(409, "resource already exists", request["transid"])
+                trigger.version = old.version.up_patch()
+                trigger.rev = old.rev
+                trigger.rules = old.rules
+            except NoDocumentException:
+                pass
+            await self.c.entity_store.put(trigger)
+            return web.json_response(trigger.to_json())
+        if request.method == "GET":
+            await self._check(request, READ, ns)
+            return web.json_response((await self.c.entity_store.get_trigger(doc_id)).to_json())
+        if request.method == "DELETE":
+            await self._check(request, DELETE, ns)
+            trigger = await self.c.entity_store.get_trigger(doc_id)
+            await self.c.entity_store.delete(trigger)
+            return web.json_response(trigger.to_json())
+        if request.method == "POST":
+            await self._check(request, ACTIVATE, ns, throttle=True,
+                              is_trigger_fire=True)
+            try:
+                payload = await request.json() if request.can_read_body else {}
+            except json.JSONDecodeError:
+                payload = {}
+            trigger = await self.c.entity_store.get_trigger(doc_id)
+            result = await self.c.trigger_service.fire(request["identity"], trigger,
+                                                       payload, request["transid"])
+            if result is None:
+                return web.Response(status=204)
+            return web.json_response({"activationId": result.asString}, status=202)
+        return _error(405, "method not allowed")
+
+    # ----------------------------------------------------------------- rules
+    async def list_rules(self, request):
+        ns = self._namespace(request)
+        await self._check(request, READ, ns)
+        limit, skip = self._list_params(request)
+        docs = await self.c.entity_store.list("rules", ns, skip, limit)
+        return web.json_response([self._summary(d) for d in docs])
+
+    async def rule_entry(self, request):
+        ns = self._namespace(request)
+        name = request.match_info["name"]
+        doc_id = f"{ns}/{name}"
+        if request.method == "PUT":
+            await self._check(request, PUT, ns)
+            overwrite = self._bool_param(request, "overwrite")
+            body = await request.json()
+            rule = WhiskRule(EntityPath(ns), EntityName(name),
+                             FullyQualifiedEntityName.parse(body["trigger"]).resolve(ns),
+                             FullyQualifiedEntityName.parse(body["action"]).resolve(ns),
+                             annotations=Parameters.from_json(body.get("annotations")))
+            return await self._put_rule(request, ns, doc_id, rule, overwrite)
+        if request.method == "GET":
+            await self._check(request, READ, ns)
+            rule = await self.c.entity_store.get_rule(doc_id)
+            j = rule.to_json()
+            j["status"] = await self.c.rule_status(rule)
+            return web.json_response(j)
+        if request.method == "DELETE":
+            await self._check(request, DELETE, ns)
+            return web.json_response(await self.c.delete_rule(doc_id))
+        if request.method == "POST":  # status change {"status": "active"|"inactive"}
+            await self._check(request, PUT, ns)
+            body = await request.json()
+            status = body.get("status")
+            if status not in (ACTIVE, "inactive"):
+                return _error(400, "status must be 'active' or 'inactive'",
+                              request["transid"])
+            await self.c.set_rule_status(doc_id, status)
+            return web.Response(status=200, text="{}",
+                                content_type="application/json")
+        return _error(405, "method not allowed")
+
+    async def _put_rule(self, request, ns, doc_id, rule: WhiskRule, overwrite: bool):
+        # validate trigger + action exist (ref Rules.scala)
+        trigger = await self.c.entity_store.get_trigger(str(rule.trigger))
+        await self.c.entity_store.get_action(str(rule.action))
+        try:
+            old = await self.c.entity_store.get_rule(doc_id)
+            if not overwrite:
+                return _error(409, "resource already exists", request["transid"])
+            rule.version = old.version.up_patch()
+            rule.rev = old.rev
+            old_trigger = await self.c.entity_store.get_trigger(str(old.trigger))
+            if str(old.trigger) != str(rule.trigger):
+                old_trigger.remove_rule(doc_id)
+                await self.c.entity_store.put(old_trigger)
+                trigger = await self.c.entity_store.get_trigger(str(rule.trigger))
+        except NoDocumentException:
+            pass
+        await self.c.entity_store.put(rule)
+        trigger.add_rule(doc_id, ReducedRule(rule.action, ACTIVE))
+        await self.c.entity_store.put(trigger)
+        j = rule.to_json()
+        j["status"] = ACTIVE
+        return web.json_response(j)
+
+    # -------------------------------------------------------------- packages
+    async def list_packages(self, request):
+        ns = self._namespace(request)
+        await self._check(request, READ, ns)
+        limit, skip = self._list_params(request)
+        docs = await self.c.entity_store.list("packages", ns, skip, limit)
+        return web.json_response([self._summary(d) for d in docs])
+
+    async def package_entry(self, request):
+        ns = self._namespace(request)
+        name = request.match_info["name"]
+        doc_id = f"{ns}/{name}"
+        if request.method == "PUT":
+            await self._check(request, PUT, ns)
+            overwrite = self._bool_param(request, "overwrite")
+            body = await request.json() if request.can_read_body else {}
+            binding = None
+            b = body.get("binding") or {}
+            if b:
+                binding = Binding(EntityPath(b["namespace"]), EntityName(b["name"]))
+                await self.c.entity_store.get_package(str(binding.fqn))  # must exist
+            pkg = WhiskPackage(EntityPath(ns), EntityName(name), binding,
+                               Parameters.from_json(body.get("parameters")),
+                               publish=bool(body.get("publish", False)),
+                               annotations=Parameters.from_json(body.get("annotations")))
+            try:
+                old = await self.c.entity_store.get_package(doc_id)
+                if not overwrite:
+                    return _error(409, "resource already exists", request["transid"])
+                pkg.version = old.version.up_patch()
+                pkg.rev = old.rev
+            except NoDocumentException:
+                pass
+            await self.c.entity_store.put(pkg)
+            return web.json_response(pkg.to_json())
+        if request.method == "GET":
+            await self._check(request, READ, ns)
+            pkg = await self.c.entity_store.get_package(doc_id)
+            j = pkg.to_json()
+            # include package contents (actions in the package), ref Packages.scala
+            actions = await self.c.entity_store.list("actions", f"{ns}/{name}",
+                                                     0, MAX_LIST_LIMIT)
+            j["actions"] = [{"name": d["name"], "version": d.get("version")}
+                            for d in actions]
+            return web.json_response(j)
+        if request.method == "DELETE":
+            await self._check(request, DELETE, ns)
+            pkg = await self.c.entity_store.get_package(doc_id)
+            contents = await self.c.entity_store.list("actions", f"{ns}/{name}", 0, 1)
+            if contents:
+                return _error(409, "Package not empty (contains at least one entity)",
+                              request["transid"])
+            await self.c.entity_store.delete(pkg)
+            return web.json_response(pkg.to_json())
+        return _error(405, "method not allowed")
+
+    # ----------------------------------------------------------- web actions
+    async def web_action(self, request):
+        """Anonymous invocation of actions annotated web-export
+        (ref WebActions.scala:375-460): /api/v1/web/{ns}/{pkg}/{name}.{ext};
+        pkg 'default' means no package."""
+        return await self.c.web_actions.handle(request)

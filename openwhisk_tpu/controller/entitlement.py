@@ -1,0 +1,163 @@
+"""Entitlement: privileges, rate throttling, concurrency throttling.
+
+Rebuild of core/controller/.../entitlement/Entitlement.scala (:86-153 rate
+throttler wiring, :197-211 kind restriction, :280-317 check pipeline) +
+RateThrottler.scala + ActivationThrottler.scala:
+  - privilege model READ/PUT/DELETE/ACTIVATE + implicit rights in the
+    subject's own namespace,
+  - per-minute rate throttle (invocations and trigger fires) with per-user
+    overrides from Identity.limits,
+  - concurrent-activation throttle backed by the load balancer's live
+    in-flight counters,
+  - per-cluster division: each controller enforces limit/clusterSize with
+    the reference's 20% overcommit (:94-99,123-133),
+  - kind whitelist (KindRestrictor).
+Device-side note: the vectorized token-bucket equivalent for bulk admission
+lives in openwhisk_tpu/ops/throttle.py and is used by the TPU balancer path.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..core.entity import Identity
+
+READ = "READ"
+PUT = "PUT"
+DELETE = "DELETE"
+ACTIVATE = "ACTIVATE"
+REJECT = "REJECT"
+
+
+class EntitlementException(Exception):
+    status = 403
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+class RejectRequest(EntitlementException):
+    pass
+
+
+class ThrottleRejectRequest(EntitlementException):
+    status = 429
+
+
+class RateThrottler:
+    """Sliding one-minute window counter per namespace (ref
+    RateThrottler.scala — the reference uses a rolling minute bucket)."""
+
+    def __init__(self, description: str, default_per_minute: int):
+        self.description = description
+        self.default_per_minute = default_per_minute
+        self._events: Dict[str, deque] = {}
+
+    def check(self, namespace_id: str, limit_override: Optional[int] = None) -> bool:
+        limit = limit_override if limit_override is not None else self.default_per_minute
+        now = time.monotonic()
+        q = self._events.setdefault(namespace_id, deque())
+        while q and q[0] <= now - 60.0:
+            q.popleft()
+        if len(q) >= limit:
+            return False
+        q.append(now)
+        return True
+
+
+class ActivationThrottler:
+    """Concurrent-activation limit backed by LB in-flight counters
+    (ref ActivationThrottler.scala)."""
+
+    def __init__(self, load_balancer, default_concurrent: int):
+        self.load_balancer = load_balancer
+        self.default_concurrent = default_concurrent
+
+    def check(self, namespace_id: str, limit_override: Optional[int] = None) -> bool:
+        limit = limit_override if limit_override is not None else self.default_concurrent
+        return self.load_balancer.active_activations_for(namespace_id) < limit
+
+
+class LocalEntitlementProvider:
+    """Grants + throttles (ref EntitlementProvider.check:280-317 and
+    LocalEntitlement explicit-grant map)."""
+
+    OVERCOMMIT = 1.2  # ref Entitlement.scala:94-99
+
+    def __init__(self, load_balancer=None,
+                 invocations_per_minute: int = 60,
+                 concurrent_invocations: int = 30,
+                 fires_per_minute: int = 60,
+                 allowed_kinds: Optional[set] = None,
+                 metrics=None):
+        self.load_balancer = load_balancer
+        self.metrics = metrics
+        self._grants: Dict[str, set] = {}
+        cluster = max(1, getattr(load_balancer, "cluster_size", 1) or 1)
+        per_instance = lambda n: max(1, int(n / cluster * self.OVERCOMMIT)) \
+            if cluster > 1 else n
+        self.invoke_rate = RateThrottler("invocations per minute",
+                                         per_instance(invocations_per_minute))
+        self.fire_rate = RateThrottler("trigger fires per minute",
+                                       per_instance(fires_per_minute))
+        self.concurrent = ActivationThrottler(load_balancer,
+                                              per_instance(concurrent_invocations))
+        self.allowed_kinds = allowed_kinds  # None = all kinds allowed
+
+    # -- explicit grants (LocalEntitlement) --------------------------------
+    def grant(self, subject: str, right: str, resource: str) -> None:
+        self._grants.setdefault(f"{subject}/{resource}", set()).add(right)
+
+    def revoke(self, subject: str, right: str, resource: str) -> None:
+        self._grants.get(f"{subject}/{resource}", set()).discard(right)
+
+    def _entitled(self, identity: Identity, right: str, namespace: str) -> bool:
+        if right in identity.rights and namespace == str(identity.namespace.name):
+            return True  # implicit rights in own namespace
+        return right in self._grants.get(f"{identity.subject}/{namespace}", set())
+
+    # -- the check pipeline ------------------------------------------------
+    async def check(self, identity: Identity, right: str, namespace: str,
+                    throttle: bool = False, is_trigger_fire: bool = False) -> None:
+        if REJECT in identity.rights:
+            raise RejectRequest("The subject is not entitled to access this API.")
+        if not self._entitled(identity, right, namespace):
+            raise RejectRequest(
+                f"The supplied authentication is not authorized to access "
+                f"'{namespace}' with {right} right.")
+        if throttle and right == ACTIVATE:
+            self._check_throttles(identity, is_trigger_fire)
+
+    def _check_throttles(self, identity: Identity, is_trigger_fire: bool) -> None:
+        ns_id = identity.namespace.uuid.asString
+        limits = identity.limits
+        if is_trigger_fire:
+            if not self.fire_rate.check(ns_id, limits.fires_per_minute):
+                self._throttle_metric("firesPerMinute")
+                raise ThrottleRejectRequest(
+                    "Too many requests in the last minute (count: exceeded, "
+                    "allowed: trigger fires per minute).")
+        else:
+            if not self.invoke_rate.check(ns_id, limits.invocations_per_minute):
+                self._throttle_metric("invocationsPerMinute")
+                raise ThrottleRejectRequest(
+                    "Too many requests in the last minute (count: exceeded, "
+                    "allowed: invocations per minute).")
+            if self.load_balancer is not None and \
+                    not self.concurrent.check(ns_id, limits.concurrent_invocations):
+                self._throttle_metric("concurrentInvocations")
+                raise ThrottleRejectRequest(
+                    "Too many concurrent requests in flight (count: exceeded, "
+                    "allowed: concurrent invocations).")
+
+    def check_kind(self, identity: Identity, kind: str) -> None:
+        """Kind whitelist (ref KindRestrictor, Entitlement.scala:197-211)."""
+        allowed = identity.limits.allowed_kinds or self.allowed_kinds
+        if allowed is not None and kind not in allowed:
+            raise RejectRequest(f"action kind '{kind}' not allowed for this subject")
+
+    def _throttle_metric(self, which: str) -> None:
+        if self.metrics:
+            self.metrics.counter(f"controller_throttle_{which}")

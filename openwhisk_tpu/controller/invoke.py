@@ -1,0 +1,110 @@
+"""The primitive invoke path: entity resolution -> ActivationMessage ->
+load balancer -> wait for the active ack (with DB-poll fallback).
+
+Rebuild of core/controller/.../actions/PrimitiveActions.scala:152-206
+(invokeSimpleAction: message construction, publish, blocking wait) and
+:592-658 (waitForActivationResponse: promise first, activation-store poll as
+the fallback when acks are lost, 202 on timeout), plus the package/binding
+parameter resolution of Packages.scala (`mergePackageWithBinding`).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from ..core.entity import (ActivationId, Identity, Parameters, WhiskAction,
+                           WhiskActivation, WhiskPackage)
+from ..core.entity.names import FullyQualifiedEntityName
+from ..database import EntityStore, NoDocumentException
+from ..messaging.message import ActivationMessage
+from ..utils.transaction import TransactionId
+
+MAX_BLOCKING_WAIT = 65.0  # ref controller maxWaitForBlockingActivation ~ 60 s
+
+
+@dataclass
+class InvokeOutcome:
+    activation: Optional[WhiskActivation]
+    activation_id: ActivationId
+    accepted: bool  # True -> 202 (no result within the wait window)
+
+
+async def resolve_action(entity_store: EntityStore, fqn: FullyQualifiedEntityName,
+                         identity: Identity) -> Tuple[WhiskAction, Parameters]:
+    """Resolve an action reference through packages/bindings, returning the
+    action and the merged package-level parameters (provider < binding).
+    Ref: WhiskPackage.mergePackageWithBinding + Actions resolution."""
+    segments = fqn.path.segments
+    if len(segments) <= 1:
+        action = await entity_store.get_action(str(fqn))
+        return action, Parameters()
+    pkg_id = f"{segments[0]}/{segments[1]}"
+    package = await entity_store.get_package(pkg_id)
+    params = package.parameters
+    provider_path = package.namespace.add(package.name)
+    if package.binding is not None:
+        provider = await entity_store.get_package(str(package.binding.fqn))
+        params = provider.parameters.merge(package.parameters)
+        provider_path = provider.namespace.add(provider.name)
+    action = await entity_store.get_action(f"{provider_path}/{fqn.name}")
+    return action, params
+
+
+class ActionInvoker:
+    def __init__(self, entity_store: EntityStore, activation_store,
+                 load_balancer, controller_instance, logger=None):
+        self.entity_store = entity_store
+        self.activation_store = activation_store
+        self.load_balancer = load_balancer
+        self.controller = controller_instance
+        self.logger = logger
+
+    async def invoke(self, identity: Identity, action: WhiskAction,
+                     package_params: Parameters, payload: Optional[Dict[str, Any]],
+                     blocking: bool, transid: Optional[TransactionId] = None,
+                     wait_override: Optional[float] = None,
+                     cause: Optional[ActivationId] = None) -> InvokeOutcome:
+        """invokeSimpleAction (:152-206): parameters merge left-to-right as
+        package < action < payload; the message carries only the payload-
+        merged arguments."""
+        transid = transid or TransactionId()
+        args = package_params.merge(action.parameters).merge(
+            Parameters.from_arguments(payload or {}))
+        msg = ActivationMessage(
+            transid=transid,
+            action=FullyQualifiedEntityName(action.namespace, action.name),
+            revision=action.rev.rev,
+            user=identity,
+            activation_id=ActivationId.generate(),
+            root_controller_index=self.controller,
+            blocking=blocking,
+            content=args.to_arguments(),
+            cause=cause,
+        )
+        promise = await self.load_balancer.publish(action, msg)
+        if not blocking:
+            return InvokeOutcome(None, msg.activation_id, accepted=True)
+        wait = min(wait_override or MAX_BLOCKING_WAIT,
+                   action.limits.timeout.seconds + 60.0)
+        return await self._wait_for_response(identity, msg, promise, wait)
+
+    async def _wait_for_response(self, identity: Identity, msg: ActivationMessage,
+                                 promise: asyncio.Future, wait: float
+                                 ) -> InvokeOutcome:
+        """waitForActivationResponse (:592-658): result promise first, then a
+        single DB poll (acks can be lost at-most-once), else 202."""
+        try:
+            activation = await asyncio.wait_for(asyncio.shield(promise), wait)
+            return InvokeOutcome(activation, msg.activation_id, accepted=False)
+        except asyncio.TimeoutError:
+            pass
+        except Exception:  # noqa: BLE001 — forced timeout etc: fall through to poll
+            pass
+        try:
+            activation = await self.activation_store.get(
+                str(identity.namespace.name), msg.activation_id)
+            return InvokeOutcome(activation, msg.activation_id, accepted=False)
+        except NoDocumentException:
+            return InvokeOutcome(None, msg.activation_id, accepted=True)

@@ -1,0 +1,6 @@
+from .base import (ActivationEntry, ActiveAckTimeout, CommonLoadBalancer,
+                   InvokerHealth, LoadBalancer, LoadBalancerException,
+                   HEALTHY, UNHEALTHY, UNRESPONSIVE, OFFLINE)
+from .lean import LeanBalancer, LeanBalancerProvider
+
+__all__ = [n for n in dir() if not n.startswith("_")]

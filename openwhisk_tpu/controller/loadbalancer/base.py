@@ -1,0 +1,274 @@
+"""LoadBalancer SPI + shared bookkeeping.
+
+Rebuild of core/controller/.../loadBalancer/LoadBalancer.scala:46-112 (the
+SPI) and CommonLoadBalancer.scala (the bookkeeping every balancer shares):
+
+  - `publish(action, msg)` returns a future that resolves to the *completion*
+    of the activation (the inner future of the reference's
+    Future[Future[Either[ActivationId, WhiskActivation]]]).
+  - per-activation `ActivationEntry` in `activation_slots` with a
+    completion-ack timeout of max(action timeout, 1 min) * timeout_factor
+    + timeout_addon (CommonLoadBalancer.scala:103-105); firing the timeout
+    force-releases the slot so leaked capacity self-heals (SURVEY §5.3).
+  - the completion-ack feed (`completed<controller>` topic) disambiguates
+    4 ways (:260-346): regular completion, forced-timeout completion, late
+    ack after forced completion (only counts toward invoker health), and
+    healthcheck acks from system test actions.
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Union
+
+from ...core.entity import (ActivationId, ExecutableWhiskAction, Identity,
+                            InvokerInstanceId, WhiskAction, WhiskActivation)
+from ...messaging.connector import MessageFeed
+from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
+                                  parse_ack)
+from ...utils.logging import MetricEmitter
+from ...utils.transaction import TransactionId
+
+# invoker states (ref InvokerState in InvokerSupervision.scala)
+HEALTHY = "up"
+UNHEALTHY = "unhealthy"
+UNRESPONSIVE = "unresponsive"
+OFFLINE = "down"
+
+USABLE_STATES = (HEALTHY, UNHEALTHY)  # ref: unhealthy still gets test traffic
+
+
+@dataclass
+class InvokerHealth:
+    id: InvokerInstanceId
+    status: str = HEALTHY
+
+    @property
+    def usable(self) -> bool:
+        return self.status in (HEALTHY,)
+
+    def to_json(self):
+        return {"invoker": self.id.as_string, "status": self.status,
+                "userMemory": self.id.user_memory.to_json()}
+
+
+class LoadBalancerException(Exception):
+    pass
+
+
+class ActiveAckTimeout(LoadBalancerException):
+    def __init__(self, activation_id: ActivationId):
+        super().__init__(f"no completion or active ack received yet for {activation_id}")
+        self.activation_id = activation_id
+
+
+@dataclass
+class ActivationEntry:
+    id: ActivationId
+    namespace_id: str
+    invoker: Optional[InvokerInstanceId]
+    memory_mb: int
+    max_concurrent: int
+    action_key: str
+    is_blackbox: bool
+    is_blocking: bool
+    timeout_task: Optional[asyncio.Task] = None
+    promise: Optional[asyncio.Future] = None
+    forced: bool = False
+
+
+class LoadBalancer:
+    """SPI surface (ref LoadBalancer.scala:46-78)."""
+
+    async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
+                      ) -> asyncio.Future:
+        """Schedule the activation; returns a future resolving to
+        WhiskActivation (completion) or raising ActiveAckTimeout."""
+        raise NotImplementedError
+
+    def active_activations_for(self, namespace_id: str) -> int:
+        raise NotImplementedError
+
+    @property
+    def total_active_activations(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def cluster_size(self) -> int:
+        return 1
+
+    async def invoker_health(self) -> List[InvokerHealth]:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class CommonLoadBalancer(LoadBalancer):
+    TIMEOUT_FACTOR = 2
+    TIMEOUT_ADDON = 60.0
+    STD_TIMEOUT = 60.0
+
+    def __init__(self, messaging_provider, controller_instance, logger=None,
+                 metrics: Optional[MetricEmitter] = None):
+        self.provider = messaging_provider
+        self.controller = controller_instance
+        self.logger = logger
+        self.metrics = metrics or MetricEmitter()
+        self.producer = messaging_provider.get_producer()
+        self.activation_slots: Dict[str, ActivationEntry] = {}
+        self.activations_per_namespace: Dict[str, int] = {}
+        self._total = 0
+        self._ack_feed: Optional[MessageFeed] = None
+
+    # -- counters (ref :60-99) --------------------------------------------
+    def active_activations_for(self, namespace_id: str) -> int:
+        return self.activations_per_namespace.get(namespace_id, 0)
+
+    @property
+    def total_active_activations(self) -> int:
+        return self._total
+
+    def _incr(self, entry: ActivationEntry) -> None:
+        self._total += 1
+        self.activations_per_namespace[entry.namespace_id] = \
+            self.activations_per_namespace.get(entry.namespace_id, 0) + 1
+
+    def _decr(self, entry: ActivationEntry) -> None:
+        self._total -= 1
+        n = self.activations_per_namespace.get(entry.namespace_id, 1) - 1
+        if n <= 0:
+            self.activations_per_namespace.pop(entry.namespace_id, None)
+        else:
+            self.activations_per_namespace[entry.namespace_id] = n
+
+    # -- activation setup (ref :116-169) -----------------------------------
+    def setup_activation(self, msg: ActivationMessage,
+                         action: Union[WhiskAction, ExecutableWhiskAction],
+                         invoker: Optional[InvokerInstanceId]) -> asyncio.Future:
+        timeout = (max(action.limits.timeout.seconds, self.STD_TIMEOUT)
+                   * self.TIMEOUT_FACTOR + self.TIMEOUT_ADDON)
+        promise: asyncio.Future = asyncio.get_event_loop().create_future()
+        # some promises are never awaited (non-blocking invokes; blocking ones
+        # that fell back to the DB poll) — retrieve the exception so a forced
+        # timeout doesn't log "Future exception was never retrieved"
+        promise.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None)
+        entry = ActivationEntry(
+            id=msg.activation_id,
+            namespace_id=msg.user.namespace.uuid.asString,
+            invoker=invoker,
+            memory_mb=action.limits.memory.megabytes,
+            max_concurrent=action.limits.concurrency.max_concurrent,
+            action_key=f"{action.fully_qualified_name}@{action.rev.rev or ''}",
+            is_blackbox=action.exec_metadata().is_blackbox,
+            is_blocking=msg.blocking,
+            promise=promise,
+        )
+        entry.timeout_task = asyncio.get_event_loop().create_task(
+            self._timeout_later(entry, timeout))
+        self.activation_slots[msg.activation_id.asString] = entry
+        self._incr(entry)
+        return promise
+
+    async def _timeout_later(self, entry: ActivationEntry, timeout: float) -> None:
+        try:
+            await asyncio.sleep(timeout)
+            self.process_completion(entry.id, forced=True, is_system_error=False,
+                                    invoker=entry.invoker)
+        except asyncio.CancelledError:
+            pass
+
+    # -- dispatch (ref :175-198) -------------------------------------------
+    async def send_activation_to_invoker(self, msg: ActivationMessage,
+                                         invoker: InvokerInstanceId) -> None:
+        topic = invoker.as_string  # "invoker<N>"
+        self.metrics.counter("loadbalancer_activations_published")
+        await self.producer.send(topic, msg)
+
+    # -- completion-ack feed (ref :205-346) --------------------------------
+    def start_ack_feed(self) -> None:
+        topic = f"completed{self.controller.as_string}"
+        self.provider.ensure_topic(topic)
+        consumer = self.provider.get_consumer(topic, f"completions-{self.controller.as_string}",
+                                              max_peek=128)
+        feed_box = {}
+
+        async def handle(payload: bytes):
+            try:
+                self.process_acknowledgement(payload)
+            finally:
+                feed_box["feed"].processed()
+
+        self._ack_feed = MessageFeed("activeack", consumer, 128, handle,
+                                     logger=self.logger)
+        feed_box["feed"] = self._ack_feed
+        self._ack_feed.start()
+
+    def process_acknowledgement(self, raw: bytes) -> None:
+        try:
+            ack: AcknowledgementMessage = parse_ack(raw)
+        except (ValueError, KeyError) as e:
+            if self.logger:
+                self.logger.error(TransactionId.LOADBALANCER,
+                                  f"corrupt completion ack: {e!r}")
+            return
+        if ack.activation is not None:
+            self.process_result(ack.activation_id, ack.activation)
+        if ack.is_slot_free:
+            self.process_completion(ack.activation_id,
+                                    forced=False,
+                                    is_system_error=ack.is_system_error,
+                                    invoker=ack.invoker)
+
+    def process_result(self, aid: ActivationId, activation: WhiskActivation) -> None:
+        """Complete the blocking client's promise (ref :235-243)."""
+        entry = self.activation_slots.get(aid.asString)
+        if entry is not None and entry.promise is not None and not entry.promise.done():
+            entry.promise.set_result(activation)
+
+    def process_completion(self, aid: ActivationId, forced: bool,
+                           is_system_error: bool,
+                           invoker: Optional[InvokerInstanceId]) -> None:
+        """Slot release with 4-way disambiguation (ref :260-346)."""
+        entry = self.activation_slots.pop(aid.asString, None)
+        if entry is not None:
+            if entry.timeout_task and not forced:
+                entry.timeout_task.cancel()
+            entry.forced = forced
+            self._decr(entry)
+            if entry.invoker is not None:
+                self.release_invoker(entry.invoker, entry)
+            if forced:
+                self.metrics.counter("loadbalancer_completion_ack_forced")
+                if entry.promise is not None and not entry.promise.done():
+                    entry.promise.set_exception(ActiveAckTimeout(aid))
+            else:
+                self.metrics.counter("loadbalancer_completion_ack_regular")
+            self.on_invocation_finished(invoker or (entry.invoker if entry else None),
+                                        is_system_error=is_system_error,
+                                        forced=forced)
+        else:
+            # late ack after a forced completion, or healthcheck ack
+            if not forced:
+                self.metrics.counter("loadbalancer_completion_ack_regularAfterForced")
+                self.on_invocation_finished(invoker, is_system_error=is_system_error,
+                                            forced=False)
+            else:
+                self.metrics.counter("loadbalancer_completion_ack_forcedAfterRegular")
+
+    # -- subclass hooks ----------------------------------------------------
+    def release_invoker(self, invoker: InvokerInstanceId, entry: ActivationEntry) -> None:
+        """Return the capacity slot taken for this activation."""
+
+    def on_invocation_finished(self, invoker: Optional[InvokerInstanceId],
+                               is_system_error: bool, forced: bool) -> None:
+        """Feed the invoker-health supervision (ref InvocationFinishedMessage)."""
+
+    async def close(self) -> None:
+        if self._ack_feed:
+            await self._ack_feed.stop()
+        for entry in list(self.activation_slots.values()):
+            if entry.timeout_task:
+                entry.timeout_task.cancel()
+        self.activation_slots.clear()

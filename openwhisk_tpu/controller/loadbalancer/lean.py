@@ -1,0 +1,55 @@
+"""LeanBalancer: single-process mode — controller and invoker share one
+process and one in-memory bus.
+
+Rebuild of core/controller/.../loadBalancer/LeanBalancer.scala:44-88: no
+broker, no remote invokers; an in-process InvokerReactive consumes the
+`invoker0` topic of the shared MemoryMessagingProvider. Capacity pressure is
+handled entirely by the invoker's own pool/buffering, exactly like the
+reference (the lean balancer does no slot bookkeeping of its own beyond the
+common activation-slot map).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
+from ...messaging.message import ActivationMessage
+from .base import HEALTHY, CommonLoadBalancer, InvokerHealth, LoadBalancer
+
+
+class LeanBalancer(CommonLoadBalancer):
+    def __init__(self, messaging_provider, controller_instance,
+                 invoker_factory, logger=None, metrics=None,
+                 user_memory=None):
+        super().__init__(messaging_provider, controller_instance, logger, metrics)
+        from ...core.entity import MB
+        self.invoker_id = InvokerInstanceId(0, unique_name="lean",
+                                            user_memory=user_memory or MB(2048))
+        self._invoker_factory = invoker_factory  # async (instance, provider) -> InvokerReactive
+        self.invoker = None
+
+    async def start(self) -> None:
+        self.provider.ensure_topic(self.invoker_id.as_string)
+        self.start_ack_feed()
+        self.invoker = await self._invoker_factory(self.invoker_id, self.provider)
+
+    async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
+                      ) -> asyncio.Future:
+        promise = self.setup_activation(msg, action, self.invoker_id)
+        await self.send_activation_to_invoker(msg, self.invoker_id)
+        return promise
+
+    async def invoker_health(self) -> List[InvokerHealth]:
+        return [InvokerHealth(self.invoker_id, HEALTHY)]
+
+    async def close(self) -> None:
+        await super().close()
+        if self.invoker is not None:
+            await self.invoker.stop()
+
+
+class LeanBalancerProvider:
+    @staticmethod
+    def instance(**kwargs) -> LeanBalancer:
+        return LeanBalancer(**kwargs)

@@ -1,0 +1,3 @@
+from .reactive import InvokerReactive, InvokerReactiveProvider
+
+__all__ = ["InvokerReactive", "InvokerReactiveProvider"]

@@ -1,0 +1,194 @@
+"""InvokerReactive: the invoker's event loop.
+
+Rebuild of core/invoker/.../invoker/InvokerReactive.scala:105-342 — consume
+the `invoker<N>` topic through a MessageFeed whose capacity equals the pool's
+slot count (maxPeek = user-memory / min-memory scaled by the concurrency peek
+factor, :172-173), fetch the action (revision-keyed cache), hand a Run to the
+ContainerPool, publish active-acks + 1 Hz health pings, and synthesize error
+activations when the action can't even be fetched (:280-307).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..containerpool import (ContainerPool, ContainerPoolConfig, ContainerProxy,
+                             Run)
+from ..containerpool.logstore import ContainerLogStore
+from ..core.entity import (ActivationResponse, EntityName, EntityPath,
+                           ExecManifest, InvokerInstanceId, MemoryLimit,
+                           WhiskActivation)
+from ..database import EntityStore, NoDocumentException
+from ..messaging.connector import MessageFeed
+from ..messaging.message import (ActivationMessage,
+                                 CombinedCompletionAndResultMessage,
+                                 CompletionMessage, PingMessage, ResultMessage)
+from ..utils.scheduler import Scheduler
+from ..utils.transaction import TransactionId
+
+HEALTH_TOPIC = "health"
+
+
+class InvokerReactive:
+    def __init__(self, instance: InvokerInstanceId, messaging_provider,
+                 entity_store: EntityStore, activation_store,
+                 container_factory, pool_config: Optional[ContainerPoolConfig] = None,
+                 logstore: Optional[ContainerLogStore] = None, logger=None,
+                 metrics=None, ping_interval: float = 1.0):
+        self.instance = instance
+        self.provider = messaging_provider
+        self.entity_store = entity_store
+        self.activation_store = activation_store
+        self.factory = container_factory
+        self.config = pool_config or ContainerPoolConfig(user_memory=instance.user_memory)
+        self.logstore = logstore or ContainerLogStore()
+        self.logger = logger
+        self.metrics = metrics
+        self.ping_interval = ping_interval
+        self.producer = messaging_provider.get_producer()
+
+        prewarm = []
+        for manifest, cell in ExecManifest.runtimes().stem_cells():
+            prewarm.append((manifest.kind, manifest.image.resolved,
+                            cell.memory.to_mb, cell.count))
+        self.pool = ContainerPool(self._make_proxy, self.config,
+                                  prewarm_config=prewarm, logger=logger,
+                                  metrics=metrics)
+        self._feed: Optional[MessageFeed] = None
+        self._pinger: Optional[Scheduler] = None
+        self._pending_release: dict = {}
+
+    # -- capacity: maxPeek mirrors ref :172-173 -----------------------------
+    def max_peek(self) -> int:
+        """max(containers, containers * maxConcurrency * peekFactor): the
+        factor <= 1 dampens over-peeking (over-peeked messages are lost on
+        crash, given the bus's at-most-once hand-off)."""
+        from ..core.entity import ConcurrencyLimit
+        slots = max(1, self.config.user_memory.to_mb // MemoryLimit.MIN.to_mb)
+        return max(slots, int(slots * ConcurrencyLimit.MAX
+                              * self.config.concurrent_peek_factor))
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, start_prewarm: bool = True) -> None:
+        topic = self.instance.as_string
+        self.provider.ensure_topic(topic)
+        self.provider.ensure_topic(HEALTH_TOPIC)
+        if start_prewarm:
+            await self.pool.start()
+        consumer = self.provider.get_consumer(topic, topic, max_peek=self.max_peek())
+        feed_box = {}
+
+        async def handle(payload: bytes):
+            # feed capacity is released when the activation fully completes
+            asyncio.get_event_loop().create_task(
+                self._process(payload, feed_box["feed"]))
+
+        self._feed = MessageFeed("activation", consumer, self.max_peek(), handle,
+                                 logger=self.logger)
+        feed_box["feed"] = self._feed
+        self._feed.start()
+        self._pinger = Scheduler(self.ping_interval, self._ping,
+                                 name=f"{topic}-pinger", logger=self.logger).start()
+
+    async def _ping(self) -> None:
+        await self.producer.send(HEALTH_TOPIC, PingMessage(self.instance))
+
+    async def stop(self) -> None:
+        if self._pinger:
+            await self._pinger.stop()
+        if self._feed:
+            await self._feed.stop()
+        await self.pool.shutdown()
+        await self.factory.cleanup()
+
+    # -- activation processing (ref :213-307) -------------------------------
+    async def _process(self, payload: bytes, feed: MessageFeed) -> None:
+        released = False
+
+        def release():
+            nonlocal released
+            if not released:
+                released = True
+                feed.processed()
+
+        try:
+            msg = ActivationMessage.parse(payload)
+        except (ValueError, KeyError) as e:
+            if self.logger:
+                self.logger.error(TransactionId.SYSTEM,
+                                  f"corrupt activation message: {e!r}", "InvokerReactive")
+            release()
+            return
+        try:
+            action = await self.entity_store.get_action(str(msg.action))
+            executable = action.to_executable()
+            if executable is None:
+                raise NoDocumentException("sequences are not executable on invokers")
+            # feed capacity frees when the activation record is stored (the
+            # proxy's last step) — registered by activation id
+            self._pending_release[msg.activation_id.asString] = release
+            self.pool.run(Run(executable, msg))
+        except NoDocumentException:
+            await self._error_activation(msg, "The requested resource does not exist.")
+            release()
+        except Exception as e:  # noqa: BLE001 — invoker loop must survive
+            if self.logger:
+                self.logger.error(msg.transid, f"activation failed: {e!r}", "InvokerReactive")
+            await self._error_activation(msg, f"Invoker error: {e}")
+            release()
+
+    # -- proxy wiring ------------------------------------------------------
+    def _make_proxy(self) -> ContainerProxy:
+        return ContainerProxy(self.factory, self._active_ack, self._store_hook,
+                              self.logstore.collect_logs, self.instance,
+                              self.config, logger=self.logger)
+
+    async def _active_ack(self, transid, activation: WhiskActivation, blocking,
+                          controller, user, kind: str) -> None:
+        topic = f"completed{controller.as_string}"
+        if kind == "result":
+            message = ResultMessage(transid, activation)
+        elif kind == "completion":
+            message = CompletionMessage(transid, activation.activation_id,
+                                        activation.response.is_whisk_error,
+                                        self.instance)
+        else:
+            message = CombinedCompletionAndResultMessage(transid, activation,
+                                                         self.instance)
+        await self.producer.send(topic, message.shrink())
+
+    async def _store_hook(self, transid, activation, user) -> None:
+        try:
+            await self._store_activation(transid, activation, user)
+        finally:
+            release = self._pending_release.pop(activation.activation_id.asString, None)
+            if release is not None:
+                release()
+
+    async def _store_activation(self, transid, activation, user) -> None:
+        try:
+            await self.activation_store.store(activation, context=user)
+        except Exception as e:  # noqa: BLE001 — losing a record must not kill the loop
+            if self.logger:
+                self.logger.error(transid, f"failed to store activation: {e!r}",
+                                  "InvokerReactive")
+
+    async def _error_activation(self, msg: ActivationMessage, reason: str) -> None:
+        """Fallback error activation when the action can't run at all
+        (ref InvokerReactive.scala:280-307)."""
+        now = time.time()
+        activation = WhiskActivation(
+            namespace=EntityPath(str(msg.user.namespace.name)),
+            name=msg.action.name, subject=msg.user.subject,
+            activation_id=msg.activation_id, start=now, end=now,
+            response=ActivationResponse.whisk_error(reason))
+        await self._active_ack(msg.transid, activation, msg.blocking,
+                               msg.root_controller_index, msg.user, "combined")
+        await self._store_activation(msg.transid, activation, msg.user)
+
+
+class InvokerReactiveProvider:
+    @staticmethod
+    def instance(**kwargs) -> InvokerReactive:
+        return InvokerReactive(**kwargs)

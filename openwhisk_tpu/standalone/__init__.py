@@ -1,0 +1,70 @@
+"""Standalone server: controller + lean balancer + in-process invoker.
+
+Rebuild of core/standalone/.../StandaloneOpenWhisk.scala — a single process
+serving the full API on one port with an in-memory (or sqlite) store, the
+in-memory bus, a LeanBalancer and an in-process InvokerReactive running
+subprocess action sandboxes. Boots with a `guest` identity whose credentials
+are printed (and stable for dev use).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..containerpool import ContainerPoolConfig
+from ..containerpool.logstore import ContainerLogStore
+from ..containerpool.process_factory import ProcessContainerFactory
+from ..controller.core import Controller
+from ..controller.loadbalancer.lean import LeanBalancer
+from ..core.entity import (BasicAuthenticationAuthKey, ControllerInstanceId,
+                           EntityName, ExecManifest, Identity, MB, Namespace,
+                           Secret, Subject, UUID, WhiskAuthRecord)
+from ..database import ArtifactActivationStore, EntityStore
+from ..invoker.reactive import InvokerReactive
+from ..messaging.memory import MemoryMessagingProvider
+from ..utils.logging import Logging
+
+# stable dev credentials (standalone/dev only, like the reference's guest key)
+GUEST_UUID = "2c9f4ad1-4a5e-4d7e-9b11-2c9f4ad10e66"
+GUEST_KEY = "tpu-native-openwhisk-standalone-guest-key-0123456789abcdef012345"
+
+
+def guest_identity() -> Identity:
+    return Identity(Subject("guest-subject"),
+                    Namespace(EntityName("guest"), UUID(GUEST_UUID)),
+                    BasicAuthenticationAuthKey(UUID(GUEST_UUID), Secret(GUEST_KEY)))
+
+
+async def make_standalone(port: int = 3233, artifact_store=None,
+                          user_memory_mb: int = 2048, logger=None,
+                          prewarm: bool = False, manifest: Optional[dict] = None
+                          ) -> Controller:
+    """Assemble and start a standalone server; returns the running Controller."""
+    logger = logger or Logging(level="warn")
+    ExecManifest.initialize(manifest)
+    provider = MemoryMessagingProvider()
+    instance = ControllerInstanceId("0")
+
+    async def invoker_factory(invoker_id, messaging_provider):
+        store = controller.artifact_store
+        invoker = InvokerReactive(
+            invoker_id, messaging_provider,
+            EntityStore(store),
+            ArtifactActivationStore(store),
+            ProcessContainerFactory(logger=logger),
+            pool_config=ContainerPoolConfig(user_memory=MB(user_memory_mb),
+                                            pause_grace=1.0),
+            logstore=ContainerLogStore(), logger=logger)
+        await invoker.start(start_prewarm=prewarm)
+        return invoker
+
+    balancer = LeanBalancer(provider, instance, invoker_factory, logger=logger,
+                            user_memory=MB(user_memory_mb))
+    controller = Controller(instance, provider, artifact_store=artifact_store,
+                            logger=logger, load_balancer=balancer)
+    # seed the guest identity
+    ident = guest_identity()
+    await controller.auth_store.put(
+        WhiskAuthRecord(ident.subject, [ident.namespace], [ident.authkey]))
+    await controller.start(port=port)
+    return controller

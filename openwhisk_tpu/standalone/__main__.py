@@ -1,0 +1,41 @@
+"""CLI: python -m openwhisk_tpu.standalone [--port 3233] [--db PATH]."""
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from . import GUEST_KEY, GUEST_UUID, make_standalone
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="Standalone OpenWhisk-TPU server")
+    parser.add_argument("--port", type=int, default=3233)
+    parser.add_argument("--db", type=str, default=None,
+                        help="sqlite path for durable storage (default: in-memory)")
+    parser.add_argument("--memory", type=int, default=2048,
+                        help="invoker user memory (MB)")
+    parser.add_argument("--prewarm", action="store_true",
+                        help="start prewarm stem cells from the runtimes manifest")
+    args = parser.parse_args()
+
+    async def run():
+        store = None
+        if args.db:
+            from ..database import SqliteArtifactStore
+            store = SqliteArtifactStore(args.db)
+        controller = await make_standalone(port=args.port, artifact_store=store,
+                                           user_memory_mb=args.memory,
+                                           prewarm=args.prewarm)
+        print(f"OpenWhisk-TPU standalone listening on :{args.port}")
+        print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")
+        print(f"  API      http://127.0.0.1:{args.port}/api/v1")
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await controller.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -49,12 +49,14 @@ class TransactionId:
     (TransactionId.scala:169-183): loadbalancer, invokerHealth, etc.
     """
 
-    __slots__ = ("id", "system", "start", "_marks")
+    __slots__ = ("id", "system", "start", "start_wallclock", "_marks")
 
-    def __init__(self, id: Optional[str] = None, system: bool = False):
+    def __init__(self, id: Optional[str] = None, system: bool = False,
+                 start_wallclock: Optional[float] = None):
         self.id = id if id is not None else f"tid_{next(_counter)}"
         self.system = system
         self.start = time.monotonic()
+        self.start_wallclock = start_wallclock if start_wallclock is not None else time.time()
         self._marks: dict[str, float] = {}
 
     # -- timing markers ----------------------------------------------------
@@ -85,13 +87,13 @@ class TransactionId:
         return (time.monotonic() - self.start) * 1e3
 
     def to_json(self):
-        return [self.id, self.start]
+        return [self.id, self.start_wallclock]
 
     @classmethod
     def from_json(cls, j) -> "TransactionId":
         if isinstance(j, list) and j:
-            t = cls(str(j[0]))
-            return t
+            wallclock = float(j[1]) if len(j) > 1 else None
+            return cls(str(j[0]), start_wallclock=wallclock)
         return cls(str(j))
 
     def __repr__(self) -> str:

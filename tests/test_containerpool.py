@@ -1,0 +1,434 @@
+"""Container plane tests: stub-driven pool/proxy behavior (mirrors reference
+ContainerPoolTests/ContainerProxyTests with stub containers + factories) and
+one real subprocess (action proxy) end-to-end run."""
+import asyncio
+import time
+
+import pytest
+
+from openwhisk_tpu.core.entity import (ActivationId, CodeExec,
+                                       ControllerInstanceId, EntityName,
+                                       EntityPath, ExecutableWhiskAction,
+                                       FullyQualifiedEntityName, Identity,
+                                       MB, ActionLimits, MemoryLimit,
+                                       ConcurrencyLimit, TimeLimit)
+from openwhisk_tpu.core.entity.ids import DocRevision
+from openwhisk_tpu.containerpool import (Container, ContainerPool,
+                                         ContainerPoolConfig, ContainerProxy,
+                                         ProcessContainerFactory, Run)
+from openwhisk_tpu.containerpool.logstore import ContainerLogStore
+from openwhisk_tpu.messaging.message import ActivationMessage
+from openwhisk_tpu.utils.transaction import TransactionId
+
+
+# ---------------------------------------------------------------------------
+# stubs (reference pattern: tests/.../containerpool/test stub factories)
+# ---------------------------------------------------------------------------
+
+class StubContainer(Container):
+    def __init__(self, cid="stub", behavior=None):
+        super().__init__(cid, ("127.0.0.1", 0))
+        self.behavior = behavior or {}
+        self.initialized = False
+        self.runs = []
+        self.suspended = False
+        self.destroyed = False
+
+    async def initialize(self, init_payload, timeout=60.0):
+        if self.behavior.get("init_fail"):
+            from openwhisk_tpu.containerpool import InitializationError
+            raise InitializationError("Initialization has failed: boom")
+        self.initialized = True
+        await asyncio.sleep(self.behavior.get("init_delay", 0))
+        return 7
+
+    async def run(self, args, environment, timeout=60.0):
+        from openwhisk_tpu.containerpool.container import RunResult
+        self.runs.append(args)
+        await asyncio.sleep(self.behavior.get("run_delay", 0))
+        start = time.time()
+        if self.behavior.get("run_timeout"):
+            return RunResult(start, time.time(), {"error": "timeout"}, ok=False,
+                             timed_out=True)
+        if self.behavior.get("run_error"):
+            return RunResult(start, time.time(),
+                             {"error": "An error has occurred while running the action."},
+                             ok=False)
+        return RunResult(start, time.time(), {"echo": args}, ok=True)
+
+    async def suspend(self):
+        self.suspended = True
+
+    async def resume(self):
+        self.suspended = False
+
+    async def destroy(self):
+        await super().destroy()
+        self.destroyed = True
+
+    async def logs(self, limit_bytes=10 * 1024 * 1024, wait_for_sentinel=True):
+        return ["stdout: hello-log"]
+
+
+class StubFactory:
+    def __init__(self, behavior=None):
+        self.behavior = behavior or {}
+        self.created = []
+
+    async def create_container(self, transid, name, image, memory, cpu_shares=0,
+                               action=None):
+        if self.behavior.get("create_fail"):
+            raise RuntimeError("no resources")
+        c = StubContainer(cid=f"stub-{len(self.created)}", behavior=self.behavior)
+        self.created.append(c)
+        return c
+
+
+class AckRecorder:
+    def __init__(self):
+        self.acks = []
+        self.stored = []
+        self.event = asyncio.Event()
+
+    async def active_ack(self, transid, activation, blocking, controller, user, kind):
+        self.acks.append((kind, activation))
+        if kind in ("completion", "combined"):
+            self.event.set()
+
+    async def store_activation(self, transid, activation, user):
+        self.stored.append(activation)
+
+
+def make_action(name="hello", memory=256, concurrency=1, kind="python:3"):
+    old_max = ConcurrencyLimit.MAX
+    ConcurrencyLimit.MAX = max(concurrency, 1)
+    try:
+        limits = ActionLimits(TimeLimit(10_000), MemoryLimit(MB(memory)), None,
+                              ConcurrencyLimit(concurrency))
+    finally:
+        ConcurrencyLimit.MAX = old_max
+    a = ExecutableWhiskAction(EntityPath("guest"), EntityName(name),
+                              CodeExec(kind=kind, code="def main(a): return a"),
+                              limits=limits)
+    a.rev = DocRevision("1-test")
+    return a
+
+
+def make_msg(action, blocking=True, content=None):
+    ident = Identity.generate("guest")
+    return ActivationMessage(
+        TransactionId(), action.fully_qualified_name, action.rev.rev, ident,
+        ActivationId.generate(), ControllerInstanceId("0"), blocking,
+        content or {"name": "world"})
+
+
+def make_proxy(factory, recorder, config=None):
+    config = config or ContainerPoolConfig(pause_grace=0.02, idle_container_timeout=5)
+    logstore = ContainerLogStore()
+    return ContainerProxy(factory, recorder.active_ack, recorder.store_activation,
+                          logstore.collect_logs, instance=0, pool_config=config)
+
+
+def make_pool(factory, recorder, user_memory_mb=1024, prewarm=None):
+    config = ContainerPoolConfig(user_memory=MB(user_memory_mb), pause_grace=0.02,
+                                 idle_container_timeout=5)
+    return ContainerPool(lambda: make_proxy(factory, recorder, config), config,
+                         prewarm_config=prewarm or [])
+
+
+# ---------------------------------------------------------------------------
+# ContainerProxy lifecycle
+# ---------------------------------------------------------------------------
+
+class TestContainerProxy:
+    def test_cold_start_run_ack_store(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action, msg = make_action(), None
+            msg = make_msg(action)
+            await proxy.run(action, msg)
+            return factory, rec, proxy
+
+        factory, rec, proxy = asyncio.run(go())
+        kinds = [k for k, _ in rec.acks]
+        assert kinds == ["result", "completion"]  # blocking: fast result, then completion
+        final = rec.acks[1][1]
+        assert final.response.is_success
+        assert final.response.result == {"echo": {"name": "world"}}
+        assert final.logs == ["stdout: hello-log"]
+        assert len(rec.stored) == 1
+        assert rec.stored[0].annotations.get("initTime") == 7
+        assert rec.stored[0].annotations.get("kind") == "python:3"
+        assert proxy.data.action_id is not None
+
+    def test_nonblocking_sends_combined(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action, blocking=False))
+            return rec
+
+        rec = asyncio.run(go())
+        assert [k for k, _ in rec.acks] == ["combined"]
+
+    def test_warm_run_skips_init(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            await proxy.run(action, make_msg(action))
+            return factory, rec
+
+        factory, rec = asyncio.run(go())
+        assert len(factory.created) == 1           # one container, two runs
+        assert len(factory.created[0].runs) == 2
+        second = rec.stored[1]
+        assert second.annotations.get("initTime") is None
+
+    def test_init_failure_is_developer_error_and_destroys(self):
+        async def go():
+            factory = StubFactory({"init_fail": True})
+            rec = AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            return factory, rec, proxy
+
+        factory, rec, proxy = asyncio.run(go())
+        assert rec.stored[0].response.status == "action developer error"
+        assert factory.created[0].destroyed
+        assert proxy._destroyed
+
+    def test_create_failure_is_whisk_error(self):
+        async def go():
+            factory = StubFactory({"create_fail": True})
+            rec = AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            return rec
+
+        rec = asyncio.run(go())
+        assert rec.stored[0].response.is_whisk_error
+
+    def test_timeout_destroys_container(self):
+        async def go():
+            factory = StubFactory({"run_timeout": True})
+            rec = AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            return factory, rec
+
+        factory, rec = asyncio.run(go())
+        assert rec.stored[0].response.status == "action developer error"
+        assert rec.stored[0].annotations.get("timeout") is True
+        assert factory.created[0].destroyed
+
+    def test_action_error_keeps_container_warm(self):
+        async def go():
+            factory = StubFactory({"run_error": True})
+            rec = AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            return factory, rec, proxy
+
+        factory, rec, proxy = asyncio.run(go())
+        assert rec.stored[0].response.is_app_error
+        assert not factory.created[0].destroyed
+        assert not proxy._destroyed
+
+    def test_pause_after_grace_and_resume_on_next_run(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            await asyncio.sleep(0.08)  # > pause_grace
+            assert factory.created[0].suspended
+            await proxy.run(action, make_msg(action))
+            return factory
+
+        factory = asyncio.run(go())
+        assert not factory.created[0].suspended
+
+    def test_prewarmed_container_inits_on_first_job(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            proxy = make_proxy(factory, rec)
+            await proxy.prestart("python:3", "action-python-v3", 256)
+            assert proxy.data.kind == "python:3"
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            return factory, rec
+
+        factory, rec = asyncio.run(go())
+        assert len(factory.created) == 1
+        assert factory.created[0].initialized
+        assert rec.stored[0].response.is_success
+
+
+# ---------------------------------------------------------------------------
+# ContainerPool scheduling
+# ---------------------------------------------------------------------------
+
+class TestContainerPool:
+    def test_warm_reuse(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            pool = make_pool(factory, rec)
+            action = make_action()
+            pool.run(Run(action, make_msg(action)))
+            await asyncio.sleep(0.05)
+            pool.run(Run(action, make_msg(action)))
+            await asyncio.sleep(0.05)
+            return factory
+
+        factory = asyncio.run(go())
+        assert len(factory.created) == 1
+
+    def test_different_actions_get_different_containers(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            pool = make_pool(factory, rec)
+            a1, a2 = make_action("one"), make_action("two")
+            pool.run(Run(a1, make_msg(a1)))
+            pool.run(Run(a2, make_msg(a2)))
+            await asyncio.sleep(0.1)
+            return factory
+
+        factory = asyncio.run(go())
+        assert len(factory.created) == 2
+
+    def test_memory_pressure_buffers_jobs(self):
+        async def go():
+            factory = StubFactory({"run_delay": 0.2})
+            rec = AckRecorder()
+            pool = make_pool(factory, rec, user_memory_mb=256)  # one 256MB slot
+            a1, a2 = make_action("one"), make_action("two")
+            pool.run(Run(a1, make_msg(a1)))
+            await asyncio.sleep(0.05)
+            pool.run(Run(a2, make_msg(a2)))
+            await asyncio.sleep(0.02)
+            buffered = len(pool.run_buffer)
+            await asyncio.sleep(0.6)
+            return factory, buffered, rec
+
+        factory, buffered, rec = asyncio.run(go())
+        assert buffered == 1         # second job waited
+        assert len(rec.stored) == 2  # ...but ran eventually (eviction freed room)
+
+    def test_eviction_frees_idle_containers(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            pool = make_pool(factory, rec, user_memory_mb=256)
+            a1 = make_action("one")
+            pool.run(Run(a1, make_msg(a1)))
+            await asyncio.sleep(0.05)  # a1 done, container idle
+            a2 = make_action("two")
+            pool.run(Run(a2, make_msg(a2)))
+            await asyncio.sleep(0.1)
+            return factory, rec
+
+        factory, rec = asyncio.run(go())
+        assert len(rec.stored) == 2
+        assert factory.created[0].destroyed  # evicted to make room
+
+    def test_prewarm_pool_used_and_backfilled(self):
+        async def go():
+            factory, rec = StubFactory(), AckRecorder()
+            pool = make_pool(factory, rec, user_memory_mb=1024,
+                             prewarm=[("python:3", "action-python-v3", 256, 1)])
+            await pool.start()
+            assert len(pool.prewarmed) == 1
+            created_before = len(factory.created)
+            action = make_action()
+            pool.run(Run(action, make_msg(action)))
+            await asyncio.sleep(0.1)
+            return factory, rec, pool, created_before
+
+        factory, rec, pool, created_before = asyncio.run(go())
+        assert created_before == 1
+        assert rec.stored[0].response.is_success
+        # stem cell consumed and backfilled
+        assert len(pool.prewarmed) == 1
+        assert len(factory.created) == 2
+
+    def test_intra_container_concurrency(self):
+        async def go():
+            factory = StubFactory({"run_delay": 0.1})
+            rec = AckRecorder()
+            pool = make_pool(factory, rec, user_memory_mb=256)
+            action = make_action(concurrency=4)
+            for _ in range(4):
+                pool.run(Run(action, make_msg(action)))
+                await asyncio.sleep(0.02)
+            await asyncio.sleep(0.3)
+            return factory, rec
+
+        factory, rec = asyncio.run(go())
+        assert len(factory.created) == 1  # all four shared one container
+        assert len(rec.stored) == 4
+
+
+# ---------------------------------------------------------------------------
+# real subprocess container (the in-repo action proxy)
+# ---------------------------------------------------------------------------
+
+class TestProcessContainer:
+    def test_end_to_end_python_action(self):
+        async def go():
+            factory = ProcessContainerFactory()
+            rec = AckRecorder()
+            config = ContainerPoolConfig(pause_grace=10, idle_container_timeout=60)
+            logstore = ContainerLogStore()
+            proxy = ContainerProxy(factory, rec.active_ack, rec.store_activation,
+                                   logstore.collect_logs, instance=0,
+                                   pool_config=config)
+            action = ExecutableWhiskAction(
+                EntityPath("guest"), EntityName("pyhello"),
+                CodeExec(kind="python:3",
+                         code="def main(args):\n"
+                              "    print('log line from action')\n"
+                              "    return {'greeting': 'Hello ' + args.get('name', '?')}\n"))
+            action.rev = DocRevision("1-e2e")
+            msg = make_msg(action, content={"name": "TPU"})
+            try:
+                await proxy.run(action, msg)
+            finally:
+                await factory.cleanup()
+            return rec
+
+        rec = asyncio.run(go())
+        final = rec.stored[0]
+        assert final.response.is_success, final.response.to_json()
+        assert final.response.result == {"greeting": "Hello TPU"}
+        assert any("log line from action" in l for l in final.logs)
+
+    def test_action_exception_is_application_error(self):
+        async def go():
+            factory = ProcessContainerFactory()
+            rec = AckRecorder()
+            config = ContainerPoolConfig(pause_grace=10, idle_container_timeout=60)
+            logstore = ContainerLogStore()
+            proxy = ContainerProxy(factory, rec.active_ack, rec.store_activation,
+                                   logstore.collect_logs, instance=0,
+                                   pool_config=config)
+            action = ExecutableWhiskAction(
+                EntityPath("guest"), EntityName("bad"),
+                CodeExec(kind="python:3", code="def main(args):\n    raise ValueError('nope')\n"))
+            action.rev = DocRevision("1-e2e")
+            try:
+                await proxy.run(action, make_msg(action))
+            finally:
+                await factory.cleanup()
+            return rec
+
+        rec = asyncio.run(go())
+        final = rec.stored[0]
+        assert final.response.is_app_error
+        assert any("ValueError" in l for l in final.logs)
