@@ -1,0 +1,98 @@
+"""Benchmark: activation placement decisions/sec on the TPU placement kernel.
+
+Measures the steady-state rate of the balancer's device step — a micro-batch
+of B=256 placements (schedule_batch) followed by the matching release fold
+(release_batch), over a 1024-invoker fleet — i.e. the full device work per
+scheduled activation, books held constant so the loop runs indefinitely.
+
+Baseline: BASELINE.json targets >= 50,000 placements/s (reference point: the
+CPU ShardingContainerPoolBalancer inner loop, which this kernel replaces).
+`vs_baseline` = measured rate / 50,000. A CPU-oracle rate is also measured
+for context (stderr).
+
+Prints ONE JSON line on stdout.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_INVOKERS = 1024
+BATCH = 256
+WARMUP = 5
+ITERS = 40
+TARGET = 50_000.0
+
+
+def main() -> None:
+    import jax
+
+    from __graft_entry__ import _example_batch
+    from openwhisk_tpu.ops.placement import (init_state, release_batch,
+                                             schedule_batch)
+
+    state0 = init_state(N_INVOKERS, [2048] * N_INVOKERS, action_slots=256)
+    batch = _example_batch(N_INVOKERS, BATCH, seed=7)
+
+    def step(state):
+        state, chosen, forced = schedule_batch(state, batch)
+        ok = chosen >= 0
+        state = release_batch(state, jax.numpy.clip(chosen, 0), batch.conc_slot,
+                              batch.need_mb, batch.max_conc, ok)
+        return state, chosen
+
+    state = state0
+    for _ in range(WARMUP):
+        state, chosen = step(state)
+    jax.block_until_ready(state)
+
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        t1 = time.perf_counter()
+        state, chosen = step(state)
+        jax.block_until_ready(chosen)
+        lat.append(time.perf_counter() - t1)
+    dt = time.perf_counter() - t0
+    rate = BATCH * ITERS / dt
+    p50_ms = sorted(lat)[len(lat) // 2] * 1e3
+
+    # CPU oracle context (the reference scheduling loop, same trace shape)
+    cpu_rate = _cpu_oracle_rate()
+    print(f"# device={jax.devices()[0]} p50_step={p50_ms:.2f}ms "
+          f"cpu_oracle={cpu_rate:.0f}/s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "placements_per_sec",
+        "value": round(rate, 1),
+        "unit": "placements/s",
+        "vs_baseline": round(rate / TARGET, 3),
+    }))
+
+
+def _cpu_oracle_rate(n: int = N_INVOKERS, reqs: int = 2048) -> float:
+    from openwhisk_tpu.models.sharding_policy import (ShardingPolicyState,
+                                                      release, schedule)
+    st = ShardingPolicyState.build([2048] * n)
+    rng = np.random.RandomState(3)
+    actions = [(f"ns{a % 8}", f"action{a}", [128, 256, 512][a % 3])
+               for a in range(64)]
+    t0 = time.perf_counter()
+    placed = []
+    for i in range(reqs):
+        ns, act, mem = actions[rng.randint(0, 64)]
+        c, _ = schedule(st, ns, act, mem)
+        placed.append((c, act, mem))
+        if len(placed) >= BATCH:
+            for c, act, mem in placed:
+                if c is not None:
+                    release(st, c, act, mem)
+            placed.clear()
+    return reqs / (time.perf_counter() - t0)
+
+
+if __name__ == "__main__":
+    main()

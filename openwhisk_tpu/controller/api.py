@@ -32,6 +32,7 @@ from ..database import DocumentConflict, NoDocumentException
 from ..utils.transaction import TransactionId
 from .entitlement import (ACTIVATE, DELETE, EntitlementException, PUT, READ,
                           ThrottleRejectRequest)
+from .loadbalancer.base import LoadBalancerException
 from .invoke import resolve_action
 
 MAX_LIST_LIMIT = 200
@@ -105,6 +106,8 @@ class ControllerApi:
                           request.get("transid"))
         except LimitViolation as e:
             return _error(400, str(e), request.get("transid"))
+        except LoadBalancerException as e:
+            return _error(503, str(e), request.get("transid"))
         except (json.JSONDecodeError, ValueError) as e:
             return _error(400, f"malformed request: {e}", request.get("transid"))
         except KeyError as e:

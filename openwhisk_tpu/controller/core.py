@@ -65,6 +65,9 @@ class Controller:
         self.web_actions = WebActionsApi(self)
         self.api = ControllerApi(self)
         self._runner: Optional[web.AppRunner] = None
+        # resources an assembler (e.g. standalone) co-locates with this
+        # controller; each must expose an async stop()
+        self.owned_resources: list = []
 
     # -- rule status handling (status lives on the trigger doc) ------------
     async def rule_status(self, rule) -> str:
@@ -108,6 +111,8 @@ class Controller:
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
+        for resource in self.owned_resources:
+            await resource.stop()
         if self.load_balancer is not None:
             await self.load_balancer.close()
         await self.cache_invalidation.stop()

@@ -2,5 +2,8 @@ from .base import (ActivationEntry, ActiveAckTimeout, CommonLoadBalancer,
                    InvokerHealth, LoadBalancer, LoadBalancerException,
                    HEALTHY, UNHEALTHY, UNRESPONSIVE, OFFLINE)
 from .lean import LeanBalancer, LeanBalancerProvider
+from .supervision import InvokerPool
+from .sharding_balancer import ShardingBalancer, ShardingBalancerProvider
+from .tpu_balancer import TpuBalancer, TpuBalancerProvider
 
 __all__ = [n for n in dir() if not n.startswith("_")]

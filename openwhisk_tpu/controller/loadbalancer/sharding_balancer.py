@@ -1,0 +1,96 @@
+"""ShardingBalancer: the CPU production balancer.
+
+The distributed-mode counterpart of the reference's default
+ShardingContainerPoolBalancer (SURVEY §2.1): scheduling math from
+models.sharding_policy, health from InvokerPool supervision, dispatch over
+the bus, slot release on completion acks. This is the drop-in CPU
+alternative to the TPU balancer behind the same LoadBalancerProvider SPI.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
+from ...messaging.message import ActivationMessage
+from ...models.sharding_policy import ShardingPolicyState, release, schedule
+from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth, LoadBalancerException)
+from .supervision import InvokerPool
+
+
+class ShardingBalancer(CommonLoadBalancer):
+    def __init__(self, messaging_provider, controller_instance, logger=None,
+                 metrics=None, cluster_size: int = 1,
+                 managed_fraction: float = 0.9, blackbox_fraction: float = 0.1):
+        super().__init__(messaging_provider, controller_instance, logger, metrics)
+        self.policy = ShardingPolicyState.build(
+            [], cluster_size=cluster_size, managed_fraction=managed_fraction,
+            blackbox_fraction=blackbox_fraction)
+        self.supervision = InvokerPool(messaging_provider,
+                                       on_status_change=self._status_change,
+                                       logger=logger)
+        self._registry: List[InvokerInstanceId] = []
+        self._usable: List[bool] = []
+
+    async def start(self) -> None:
+        self.start_ack_feed()
+        self.supervision.start()
+
+    def _status_change(self, instance: InvokerInstanceId, status: str) -> None:
+        # backfill gaps as UNUSABLE placeholders: invoker N's ping may arrive
+        # before 0..N-1's (bus ordering race) and never-seen invokers must
+        # not receive traffic (their registry entries would misdispatch)
+        idx = instance.instance
+        while idx >= len(self._registry):
+            self._registry.append(InvokerInstanceId(
+                len(self._registry), user_memory=instance.user_memory))
+            self._usable.append(False)
+        self._registry[idx] = instance
+        self._usable[idx] = status == HEALTHY
+        self.policy.update_invokers(
+            [i.user_memory.to_mb for i in self._registry],
+            usable=list(self._usable))
+
+    async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
+                      ) -> asyncio.Future:
+        meta = action.exec_metadata()
+        chosen, forced = schedule(
+            self.policy, str(msg.user.namespace.name),
+            str(action.fully_qualified_name),
+            action.limits.memory.megabytes,
+            action.limits.concurrency.max_concurrent,
+            blackbox=meta.is_blackbox)
+        if chosen is None:
+            raise LoadBalancerException(
+                "No invokers available to schedule the activation.")
+        if forced:
+            self.metrics.counter("loadbalancer_forced_placements")
+        invoker = self._registry[chosen]
+        promise = self.setup_activation(msg, action, invoker)
+        await self.send_activation_to_invoker(msg, invoker)
+        return promise
+
+    def release_invoker(self, invoker: InvokerInstanceId, entry) -> None:
+        action_name = entry.action_key.rsplit("@", 1)[0]
+        release(self.policy, invoker.instance, action_name, entry.memory_mb,
+                entry.max_concurrent)
+
+    def on_invocation_finished(self, invoker, is_system_error, forced) -> None:
+        self.supervision.on_invocation_finished(invoker, is_system_error, forced)
+
+    async def invoker_health(self) -> List[InvokerHealth]:
+        return self.supervision.health()
+
+    @property
+    def cluster_size(self) -> int:
+        return self.policy.cluster_size
+
+    async def close(self) -> None:
+        await self.supervision.stop()
+        await super().close()
+
+
+class ShardingBalancerProvider:
+    @staticmethod
+    def instance(**kwargs) -> ShardingBalancer:
+        return ShardingBalancer(**kwargs)

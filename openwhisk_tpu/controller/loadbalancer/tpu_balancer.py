@@ -1,0 +1,382 @@
+"""TpuBalancer: placement decisions computed on TPU.
+
+The north-star component (BASELINE.json): a LoadBalancerProvider whose
+scheduling inner loop — the reference's per-activation CPU probe walk
+(ShardingContainerPoolBalancer.schedule) — runs as a vectorized device
+kernel over the live fleet state:
+
+  publish() ──> micro-batch buffer ──┐ (adaptive window: flush at max_batch
+                                     │  or after batch_window seconds)
+  completion acks ──> release buffer ┤
+  health transitions ─> health buffer┤
+                                     ▼
+            one device step: release_batch ∘ set_health ∘ schedule_batch
+                                     │
+             assignments ──> ActivationMessage dispatch over the bus
+
+Design notes (SURVEY §7 "hard parts"):
+  - batching vs latency: requests wait at most `batch_window` (default
+    2 ms) or until `max_batch` queue; a single in-flight device step at a
+    time keeps ordering and lets the next window fill while one computes.
+  - host<->device coherence: acks and health flips never touch device state
+    directly — they buffer host-side and fold in at the next step boundary
+    (double-buffered deltas), so the kernel never races its own state.
+  - dynamic fleets: arrays are padded to powers of two; fleet growth re-pads
+    (a rare recompile) while health flips are O(1) device updates.
+  - intra-batch contention: lax.scan preserves the reference's sequential
+    read-modify-write semantics exactly (see ops/placement.py).
+
+Fleet partitioning, hashing, coprime steps and cluster-share division all
+reuse the CPU policy's formulas (models.sharding_policy) so the kernel stays
+bit-for-bit parity-testable against the oracle.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
+from ...messaging.message import ActivationMessage
+from ...models.sharding_policy import (MIN_SLOT_MB, generate_hash,
+                                       pairwise_coprimes)
+from ...ops.placement import (PlacementState, RequestBatch, init_state,
+                              release_batch, schedule_batch)
+from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
+                   LoadBalancerException)
+from .supervision import InvokerPool
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _mod_inverse(step: int, m: int) -> int:
+    return pow(step, -1, m) if m > 1 else 0
+
+
+class _SlotAllocator:
+    """Host-side collision-free action->concurrency-slot mapping (the inner
+    NestedSemaphore level is dense on device; slots recycle when no
+    in-flight activation references them)."""
+
+    def __init__(self, n_slots: int):
+        self.n_slots = n_slots
+        self.slots: Dict[str, int] = {}
+        self.refcount: Dict[str, int] = {}
+        self.free: List[int] = list(range(n_slots - 1, -1, -1))
+
+    def acquire(self, key: str) -> int:
+        if key not in self.slots:
+            if not self.free:
+                # saturated: fall back to hashing (collisions conflate pools)
+                return hash(key) % self.n_slots
+            self.slots[key] = self.free.pop()
+        self.refcount[key] = self.refcount.get(key, 0) + 1
+        return self.slots[key]
+
+    def release(self, key: str) -> None:
+        n = self.refcount.get(key, 0) - 1
+        if n <= 0:
+            self.refcount.pop(key, None)
+            slot = self.slots.pop(key, None)
+            if slot is not None:
+                self.free.append(slot)
+        else:
+            self.refcount[key] = n
+
+
+class TpuBalancer(CommonLoadBalancer):
+    def __init__(self, messaging_provider, controller_instance, logger=None,
+                 metrics=None, cluster_size: int = 1,
+                 managed_fraction: float = 0.9, blackbox_fraction: float = 0.1,
+                 batch_window: float = 0.002, max_batch: int = 256,
+                 action_slots: int = 4096, initial_pad: int = 64,
+                 mesh=None):
+        super().__init__(messaging_provider, controller_instance, logger, metrics)
+        self._cluster_size = cluster_size
+        self.managed_fraction = managed_fraction
+        self.blackbox_fraction = blackbox_fraction
+        self.batch_window = batch_window
+        self.max_batch = max_batch
+        self.action_slots = action_slots
+        self.mesh = mesh
+        self._n_pad = max(initial_pad, (mesh and np.prod(list(mesh.shape.values()))) or 1)
+
+        self._registry: List[InvokerInstanceId] = []
+        self._healthy: List[bool] = []
+        self._slots = _SlotAllocator(action_slots)
+        self._rand_counter = 0
+
+        self.state: Optional[PlacementState] = None
+        self._sched_fn = None
+        self._release_fn = None
+        self._init_device_state()
+
+        # pending request queue + delta buffers
+        self._pending: List[tuple] = []      # (req_dict, future)
+        self._releases: List[tuple] = []     # (inv_idx, slot, mem, maxc, key)
+        self._health_updates: Dict[int, bool] = {}
+        self._flush_task: Optional[asyncio.Task] = None
+        self._step_lock = asyncio.Lock()
+
+        self.supervision = InvokerPool(messaging_provider,
+                                       on_status_change=self._status_change,
+                                       logger=logger)
+        self._recompute_partitions()
+
+    # -- device state ------------------------------------------------------
+    def _init_device_state(self) -> None:
+        n = len(self._registry)
+        slot_mb = [self._slot_mb(i.user_memory.to_mb) for i in self._registry]
+        state = init_state(n or 1, slot_mb or [0], n_pad=self._n_pad,
+                           action_slots=self.action_slots)
+        health = jnp.zeros_like(state.health)
+        if self._healthy:
+            health = health.at[jnp.arange(len(self._healthy))].set(
+                jnp.asarray(self._healthy, bool))
+        state = state._replace(health=health)
+        if self.mesh is not None:
+            from ...parallel.sharded_state import (make_sharded_release,
+                                                   make_sharded_schedule,
+                                                   shard_state)
+            self.state = shard_state(state, self.mesh)
+            self._sched_fn = make_sharded_schedule(self.mesh)
+            self._release_fn = make_sharded_release(self.mesh)
+        else:
+            self.state = state
+            self._sched_fn = schedule_batch
+            self._release_fn = release_batch
+
+    def _slot_mb(self, user_memory_mb: int) -> int:
+        return max(user_memory_mb // self._cluster_size, MIN_SLOT_MB)
+
+    # -- fleet bookkeeping -------------------------------------------------
+    def _status_change(self, instance: InvokerInstanceId, status: str) -> None:
+        idx = instance.instance
+        new_rows = []
+        while idx >= len(self._registry):
+            new_rows.append(len(self._registry))
+            self._registry.append(instance)
+            self._healthy.append(False)
+        self._registry[idx] = instance
+        self._healthy[idx] = status == HEALTHY
+        if new_rows:
+            if len(self._registry) > self._n_pad:
+                self._grow_padding(_next_pow2(len(self._registry)))
+            # initialize ONLY the new rows (full capacity, health set below);
+            # existing rows keep their in-flight holds
+            slot_vals = jnp.asarray(
+                [self._slot_mb(self._registry[i].user_memory.to_mb)
+                 for i in new_rows], jnp.int32)
+            self.state = self.state._replace(
+                free_mb=self.state.free_mb.at[jnp.asarray(new_rows)].set(slot_vals))
+        self._health_updates[idx] = self._healthy[idx]
+        self._recompute_partitions()
+
+    def _grow_padding(self, new_pad: int) -> None:
+        """Re-pad the device arrays, PRESERVING the live books (in-flight
+        memory holds and concurrency permits survive fleet growth; only
+        update_cluster resets them, which is reference behavior)."""
+        old_free = np.asarray(self.state.free_mb)
+        old_conc = np.asarray(self.state.conc_free)
+        old_health = np.asarray(self.state.health)
+        n_old = old_free.shape[0]
+        free = np.zeros((new_pad,), np.int32)
+        free[:n_old] = old_free
+        conc = np.zeros((new_pad, self.action_slots), np.int32)
+        conc[:n_old] = old_conc
+        health = np.zeros((new_pad,), bool)
+        health[:n_old] = old_health
+        self._n_pad = new_pad
+        state = PlacementState(jnp.asarray(free), jnp.asarray(conc),
+                               jnp.asarray(health))
+        if self.mesh is not None:
+            from ...parallel.sharded_state import shard_state
+            state = shard_state(state, self.mesh)
+        self.state = state
+
+    def _recompute_partitions(self) -> None:
+        n = len(self._registry)
+        self.managed_count = max(int(self.managed_fraction * n), 1) if n else 0
+        self.blackbox_count = max(int(self.blackbox_fraction * n), 1) if n else 0
+        self._steps_managed = pairwise_coprimes(max(1, self.managed_count))
+        self._steps_blackbox = pairwise_coprimes(max(1, self.blackbox_count))
+
+    def update_cluster(self, cluster_size: int) -> None:
+        """Controller joined/left: re-shard every invoker's memory
+        (ref updateCluster :561-584)."""
+        if cluster_size != self._cluster_size:
+            self._cluster_size = cluster_size
+            self._init_device_state()
+
+    @property
+    def cluster_size(self) -> int:
+        return self._cluster_size
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        self.start_ack_feed()
+        self.supervision.start()
+
+    async def close(self) -> None:
+        await self.supervision.stop()
+        if self._flush_task:
+            self._flush_task.cancel()
+        # fail queued publishers instead of leaving them awaiting forever
+        pending, self._pending = self._pending, []
+        for _, fut, slot_key in pending:
+            self._slots.release(slot_key)
+            if not fut.done():
+                fut.set_exception(LoadBalancerException("load balancer shut down"))
+        self._releases.clear()
+        await super().close()
+
+    # -- publish -----------------------------------------------------------
+    async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
+                      ) -> asyncio.Future:
+        n = len(self._registry)
+        if n == 0 or not any(self._healthy):
+            raise LoadBalancerException(
+                "No invokers available to schedule the activation.")
+        meta = action.exec_metadata()
+        blackbox = meta.is_blackbox
+        size = self.blackbox_count if blackbox else self.managed_count
+        offset = (n - self.blackbox_count) if blackbox else 0
+        h = generate_hash(str(msg.user.namespace.name),
+                          str(action.fully_qualified_name))
+        steps = self._steps_blackbox if blackbox else self._steps_managed
+        step = steps[h % len(steps)]
+        self._rand_counter += 1
+        mem = action.limits.memory.megabytes
+        maxc = action.limits.concurrency.max_concurrent
+        slot_key = f"{action.fully_qualified_name}:{mem}"
+        req = {
+            "offset": offset, "size": size, "home": h % size,
+            "step_inv": _mod_inverse(step, size), "need_mb": mem,
+            "conc_slot": self._slots.acquire(slot_key), "max_conc": maxc,
+            "rand": (h ^ (self._rand_counter * 2654435761)) % max(size, 1),
+        }
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending.append((req, fut, slot_key))
+        self._arm_flush(urgent=len(self._pending) >= self.max_batch)
+        inv_idx, forced = await fut
+        if inv_idx < 0:
+            self._slots.release(slot_key)
+            raise LoadBalancerException(
+                "No invokers available to schedule the activation.")
+        if forced:
+            self.metrics.counter("loadbalancer_forced_placements")
+        invoker = self._registry[inv_idx]
+        promise = self.setup_activation(msg, action, invoker)
+        await self.send_activation_to_invoker(msg, invoker)
+        return promise
+
+    # -- completion hooks --------------------------------------------------
+    def release_invoker(self, invoker: InvokerInstanceId, entry) -> None:
+        action_name = entry.action_key.rsplit("@", 1)[0]
+        key = f"{action_name}:{entry.memory_mb}"
+        slot = self._slots.slots.get(key)
+        if slot is None:
+            slot = hash(key) % self.action_slots
+        self._releases.append((invoker.instance, slot, entry.memory_mb,
+                               entry.max_concurrent, key))
+        self._arm_flush()
+
+    def on_invocation_finished(self, invoker, is_system_error, forced) -> None:
+        self.supervision.on_invocation_finished(invoker, is_system_error, forced)
+
+    async def invoker_health(self) -> List[InvokerHealth]:
+        return self.supervision.health()
+
+    # -- the device step ---------------------------------------------------
+    def _arm_flush(self, urgent: bool = False) -> None:
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.get_event_loop().create_task(
+                self._flush_later(0 if urgent else self.batch_window))
+
+    async def _flush_later(self, delay: float) -> None:
+        # loop INSIDE the task until drained: a tail call to _arm_flush would
+        # be a no-op (this task is not done() yet) and strand leftover work
+        while True:
+            if delay:
+                await asyncio.sleep(delay)
+            async with self._step_lock:
+                await self._device_step()
+            if not (self._pending or self._releases or self._health_updates):
+                return
+            delay = self.batch_window
+
+    @staticmethod
+    def _bucket(n: int, cap: int) -> int:
+        """Pad batch sizes to power-of-two buckets so the jitted kernels see
+        at most log2(max_batch) distinct shapes (no per-size recompiles)."""
+        b = 8
+        while b < n and b < cap:
+            b *= 2
+        return min(b, cap) if n <= cap else cap
+
+    async def _device_step(self) -> None:
+        # 1. fold buffered releases
+        if self._releases:
+            cap = self.max_batch * 4
+            rel, self._releases = self._releases[:cap], self._releases[cap:]
+            b = self._bucket(len(rel), cap)
+            pad = b - len(rel)
+            inv = jnp.asarray([r[0] for r in rel] + [0] * pad, jnp.int32)
+            slot = jnp.asarray([r[1] for r in rel] + [0] * pad, jnp.int32)
+            mem = jnp.asarray([r[2] for r in rel] + [0] * pad, jnp.int32)
+            maxc = jnp.asarray([r[3] for r in rel] + [1] * pad, jnp.int32)
+            valid = jnp.asarray([True] * len(rel) + [False] * pad, bool)
+            self.state = self._release_fn(self.state, inv, slot, mem, maxc, valid)
+            for r in rel:
+                self._slots.release(r[4])
+        # 2. fold health flips
+        if self._health_updates:
+            ups = self._health_updates
+            self._health_updates = {}
+            idx = jnp.asarray(list(ups.keys()), jnp.int32)
+            val = jnp.asarray(list(ups.values()), bool)
+            health = self.state.health.at[idx].set(val)
+            self.state = self.state._replace(health=health)
+        # 3. schedule the micro-batch
+        if not self._pending:
+            return
+        batch, self._pending = self._pending[: self.max_batch], \
+            self._pending[self.max_batch:]
+        t0 = time.monotonic()
+        reqs = [r for r, _, _ in batch]
+        b = len(reqs)
+        bp = self._bucket(b, self.max_batch)
+        pad_req = {"offset": 0, "size": 1, "home": 0, "step_inv": 0,
+                   "need_mb": 0, "conc_slot": 0, "max_conc": 1, "rand": 0}
+        reqs_p = reqs + [pad_req] * (bp - b)
+        cols = {k: jnp.asarray([r[k] for r in reqs_p], jnp.int32)
+                for k in ("offset", "size", "home", "step_inv", "need_mb",
+                          "conc_slot", "max_conc", "rand")}
+        rb = RequestBatch(cols["offset"], cols["size"], cols["home"],
+                          cols["step_inv"], cols["need_mb"], cols["conc_slot"],
+                          cols["max_conc"], cols["rand"],
+                          jnp.asarray([True] * b + [False] * (bp - b), bool))
+        self.state, chosen, forced = self._sched_fn(self.state, rb)
+        chosen_np = np.asarray(chosen)
+        forced_np = np.asarray(forced)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
+        self.metrics.counter("loadbalancer_tpu_scheduled", b)
+        for (_, fut, _), inv_idx, f in zip(batch, chosen_np, forced_np):
+            if not fut.done():
+                fut.set_result((int(inv_idx), bool(f)))
+
+
+class TpuBalancerProvider:
+    @staticmethod
+    def instance(**kwargs) -> TpuBalancer:
+        return TpuBalancer(**kwargs)

@@ -1,0 +1,12 @@
+"""Placement policy models.
+
+`sharding_policy` is the faithful CPU re-implementation of the reference's
+ShardingContainerPoolBalancer scheduling math — it is simultaneously (a) a
+production CPU policy, (b) the parity oracle the TPU kernel is tested
+against, and (c) the CPU baseline bench.py compares to. The batched
+TPU-native formulation of the same policy lives in openwhisk_tpu.ops.
+"""
+from .sharding_policy import (ShardingPolicyState, generate_hash,
+                              pairwise_coprimes, schedule)
+
+__all__ = ["ShardingPolicyState", "generate_hash", "pairwise_coprimes", "schedule"]

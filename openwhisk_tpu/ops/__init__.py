@@ -1,0 +1,12 @@
+"""Device kernels (JAX/XLA) — the accelerator-native pieces of the framework.
+
+`placement` — the batched invoker-placement kernel (the hot loop of the
+controller's load balancer, replacing ShardingContainerPoolBalancer.schedule's
+per-activation CPU probe loop with a vectorized bin-packing step).
+`throttle` — vectorized token-bucket admission for bulk entitlement checks.
+"""
+from .placement import (PlacementState, RequestBatch, init_state,
+                        schedule_batch, release_batch, set_health)
+from .throttle import TokenBucketState, admit_batch, init_buckets
+
+__all__ = [n for n in dir() if not n.startswith("_")]

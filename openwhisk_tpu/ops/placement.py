@@ -1,0 +1,165 @@
+"""Batched invoker placement on device.
+
+The TPU-native reformulation of the reference's scheduling inner loop
+(ShardingContainerPoolBalancer.scala:398-436). The reference probes invokers
+one-by-one per activation (home + k*step mod n, step coprime to n). Key
+observation: because gcd(step, n) = 1, the probe ORDER is a permutation with
+closed-form rank
+
+    rank(i) = (i - home) * step^{-1}  (mod n)
+
+so "first invoker with capacity along the probe sequence" becomes
+"argmin(rank) over eligible invokers" — one vectorized reduction over the
+fleet instead of a sequential walk. A micro-batch of B activations is then a
+`lax.scan` of B such reductions with the capacity state carried through,
+which preserves the reference's sequential read-modify-write semantics
+exactly (intra-batch contention resolves identically to processing the
+requests one at a time).
+
+State (static shapes; fleets grow into padding, SURVEY §7 risk list):
+  free_mb   int32[N]     free memory permits per invoker (this controller's
+                         shard; may go negative under forced placement, the
+                         ForcibleSemaphore over-commit semantics)
+  conc_free int32[N, A]  spare intra-container concurrency permits per
+                         (invoker, action-slot) — the NestedSemaphore inner
+                         level. Slot ids are assigned host-side (collision-
+                         free up to A live actions).
+  health    bool[N]      usable mask (Healthy; flips fold in from the
+                         supervision feed)
+
+Request batch (int32[B] each): partition offset/size (managed vs blackbox
+fleet slice), home, step_inv (modular inverse of the coprime step), need_mb,
+conc_slot, max_conc, rand (forced-placement choice), valid.
+
+Returns (new_state, chosen int32[B] — global invoker index or -1, forced
+bool[B]). Overload forces a random usable invoker (over-commit); no usable
+invokers -> -1.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PlacementState(NamedTuple):
+    free_mb: jax.Array    # int32[N]
+    conc_free: jax.Array  # int32[N, A]
+    health: jax.Array     # bool[N]
+
+
+class RequestBatch(NamedTuple):
+    offset: jax.Array     # int32[B] partition start
+    size: jax.Array       # int32[B] partition length
+    home: jax.Array       # int32[B] hash % size
+    step_inv: jax.Array   # int32[B] inverse of step mod size
+    need_mb: jax.Array    # int32[B]
+    conc_slot: jax.Array  # int32[B]
+    max_conc: jax.Array   # int32[B]
+    rand: jax.Array       # int32[B] randomness for forced placement
+    valid: jax.Array      # bool[B]
+
+
+def init_state(n_invokers: int, slot_mb, n_pad: int = 0, action_slots: int = 512
+               ) -> PlacementState:
+    """Build device state; `slot_mb` is scalar or per-invoker list. Padding
+    rows are unhealthy with zero capacity."""
+    n_pad = n_pad or n_invokers
+    assert n_pad >= n_invokers
+    free = jnp.zeros((n_pad,), jnp.int32)
+    slot_arr = jnp.broadcast_to(jnp.asarray(slot_mb, jnp.int32), (n_invokers,))
+    free = free.at[:n_invokers].set(slot_arr)
+    health = jnp.zeros((n_pad,), bool).at[:n_invokers].set(True)
+    conc = jnp.zeros((n_pad, action_slots), jnp.int32)
+    return PlacementState(free, conc, health)
+
+
+def set_health(state: PlacementState, idx, usable) -> PlacementState:
+    return state._replace(health=state.health.at[jnp.asarray(idx)].set(
+        jnp.asarray(usable)))
+
+
+def _schedule_one(state: PlacementState, req) -> Tuple[PlacementState, Tuple]:
+    """One activation: vectorized probe + capacity update (scan body)."""
+    offset, size, home, step_inv, need, slot, max_conc, rand, valid = req
+    n = state.free_mb.shape[0]
+    big = jnp.int32(n + 2)
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    local = idx - offset
+    in_part = (local >= 0) & (local < size)
+    size_safe = jnp.maximum(size, 1)
+    # probe-order rank via modular inverse of the coprime step
+    rank = jnp.mod((local - home) * step_inv, size_safe)
+
+    conc_col = jax.lax.dynamic_index_in_dim(state.conc_free, slot, axis=1,
+                                            keepdims=False)
+    has_conc = conc_col > 0
+    has_mem = state.free_mb >= need
+    eligible = in_part & state.health & (has_conc | has_mem)
+    key = jnp.where(eligible, rank, big)
+    choice = jnp.argmin(key)
+    found = key[choice] < big
+
+    # overload: force a usable invoker chosen by a random rotation
+    usable = in_part & state.health
+    fkey = jnp.where(usable, jnp.mod(local - rand, size_safe), big)
+    fchoice = jnp.argmin(fkey)
+    have_usable = fkey[fchoice] < big
+
+    sel = jnp.where(found, choice, fchoice)
+    placed = valid & (found | have_usable)
+    forced = valid & ~found & have_usable
+
+    # capacity update (NestedSemaphore.tryAcquireConcurrent semantics)
+    use_conc = placed & (conc_col[sel] > 0)
+    take_mem = placed & ~use_conc
+    free_mb = state.free_mb.at[sel].add(
+        jnp.where(take_mem, -need, 0).astype(jnp.int32))
+    conc_delta = jnp.where(use_conc, -1,
+                           jnp.where(take_mem & (max_conc > 1), max_conc - 1, 0))
+    conc_free = state.conc_free.at[sel, slot].add(conc_delta.astype(jnp.int32))
+
+    out_choice = jnp.where(placed, sel, -1)
+    return PlacementState(free_mb, conc_free, state.health), (out_choice, forced)
+
+
+@jax.jit
+def schedule_batch(state: PlacementState, batch: RequestBatch
+                   ) -> Tuple[PlacementState, jax.Array, jax.Array]:
+    """Place a micro-batch sequentially (lax.scan) with vectorized probes."""
+    reqs = (batch.offset, batch.size, batch.home, batch.step_inv,
+            batch.need_mb, batch.conc_slot, batch.max_conc, batch.rand,
+            batch.valid)
+    new_state, (chosen, forced) = jax.lax.scan(
+        lambda s, r: _schedule_one(s, r), state, reqs)
+    return new_state, chosen, forced
+
+
+def _release_one(state: PlacementState, rel) -> Tuple[PlacementState, Tuple]:
+    inv, slot, need, max_conc, valid = rel
+    simple = valid & (max_conc <= 1)
+    conc_val = state.conc_free[inv, slot] + 1
+    reduced = valid & (max_conc > 1) & (conc_val >= max_conc)
+    # concurrency release: +1 permit; a full container's worth free ->
+    # reduce by max_conc and return the container's memory
+    conc_delta = jnp.where(valid & (max_conc > 1),
+                           jnp.where(reduced, 1 - max_conc, 1), 0)
+    free_delta = jnp.where(simple | reduced, need, 0)
+    return PlacementState(
+        state.free_mb.at[inv].add(free_delta.astype(jnp.int32)),
+        state.conc_free.at[inv, slot].add(conc_delta.astype(jnp.int32)),
+        state.health), ()
+
+
+@jax.jit
+def release_batch(state: PlacementState, inv, slot, need_mb, max_conc, valid
+                  ) -> PlacementState:
+    """Fold a batch of completion releases into the state (ref
+    releaseInvoker / NestedSemaphore.releaseConcurrent)."""
+    new_state, _ = jax.lax.scan(
+        lambda s, r: _release_one(s, r),
+        state, (inv, slot, need_mb, max_conc, valid))
+    return new_state

@@ -1,0 +1,16 @@
+"""Multi-chip sharding of the balancer state.
+
+The reference scales its balancer horizontally by giving each controller
+JVM 1/clusterSize of every invoker's memory (Akka-Cluster membership,
+ShardingContainerPoolBalancer.scala:449-585). The TPU-native equivalent in
+this package shards the *invoker axis itself* across a `jax.sharding.Mesh`:
+each device owns the capacity/health rows of its invoker shard, probes them
+locally, and a single all-gather per scan step elects the global placement —
+collectives ride ICI, host code never touches per-invoker state (SURVEY
+§2.6 item 8, §5.8).
+"""
+from .sharded_state import (make_mesh, make_sharded_schedule,
+                            make_sharded_release, shard_state)
+
+__all__ = ["make_mesh", "make_sharded_schedule", "make_sharded_release",
+           "shard_state"]

@@ -17,8 +17,8 @@ from ..containerpool.process_factory import ProcessContainerFactory
 from ..controller.core import Controller
 from ..controller.loadbalancer.lean import LeanBalancer
 from ..core.entity import (BasicAuthenticationAuthKey, ControllerInstanceId,
-                           EntityName, ExecManifest, Identity, MB, Namespace,
-                           Secret, Subject, UUID, WhiskAuthRecord)
+                           EntityName, ExecManifest, Identity, InvokerInstanceId,
+                           MB, Namespace, Secret, Subject, UUID, WhiskAuthRecord)
 from ..database import ArtifactActivationStore, EntityStore
 from ..invoker.reactive import InvokerReactive
 from ..messaging.memory import MemoryMessagingProvider
@@ -37,9 +37,13 @@ def guest_identity() -> Identity:
 
 async def make_standalone(port: int = 3233, artifact_store=None,
                           user_memory_mb: int = 2048, logger=None,
-                          prewarm: bool = False, manifest: Optional[dict] = None
-                          ) -> Controller:
-    """Assemble and start a standalone server; returns the running Controller."""
+                          prewarm: bool = False, manifest: Optional[dict] = None,
+                          balancer: str = "lean") -> Controller:
+    """Assemble and start a standalone server; returns the running Controller.
+
+    balancer: "lean" (in-process dispatch, no supervision — the reference's
+    LeanBalancer mode) or "tpu" (the device placement kernel fed by the
+    in-process invoker's real health pings)."""
     logger = logger or Logging(level="warn")
     ExecManifest.initialize(manifest)
     provider = MemoryMessagingProvider()
@@ -58,13 +62,30 @@ async def make_standalone(port: int = 3233, artifact_store=None,
         await invoker.start(start_prewarm=prewarm)
         return invoker
 
-    balancer = LeanBalancer(provider, instance, invoker_factory, logger=logger,
-                            user_memory=MB(user_memory_mb))
+    if balancer == "tpu":
+        from ..controller.loadbalancer.tpu_balancer import TpuBalancer
+        lb = TpuBalancer(provider, instance, logger=logger,
+                         metrics=logger.metrics,
+                         managed_fraction=1.0, blackbox_fraction=0.0)
+    else:
+        lb = LeanBalancer(provider, instance, invoker_factory, logger=logger,
+                          user_memory=MB(user_memory_mb))
     controller = Controller(instance, provider, artifact_store=artifact_store,
-                            logger=logger, load_balancer=balancer)
+                            logger=logger, load_balancer=lb)
     # seed the guest identity
     ident = guest_identity()
     await controller.auth_store.put(
         WhiskAuthRecord(ident.subject, [ident.namespace], [ident.authkey]))
     await controller.start(port=port)
+    if balancer == "tpu":
+        # the TPU balancer talks to invokers over the bus + health pings:
+        # boot the in-process invoker beside it and wait for its first ping
+        invoker = await invoker_factory(
+            InvokerInstanceId(0, unique_name="standalone",
+                              user_memory=MB(user_memory_mb)), provider)
+        controller.owned_resources.append(invoker)
+        for _ in range(100):
+            if any(lb._healthy):
+                break
+            await asyncio.sleep(0.05)
     return controller

@@ -16,6 +16,9 @@ def main() -> None:
                         help="invoker user memory (MB)")
     parser.add_argument("--prewarm", action="store_true",
                         help="start prewarm stem cells from the runtimes manifest")
+    parser.add_argument("--balancer", choices=("lean", "tpu"), default="lean",
+                        help="load balancer: lean (in-process) or tpu "
+                             "(device placement kernel)")
     args = parser.parse_args()
 
     async def run():
@@ -25,8 +28,10 @@ def main() -> None:
             store = SqliteArtifactStore(args.db)
         controller = await make_standalone(port=args.port, artifact_store=store,
                                            user_memory_mb=args.memory,
-                                           prewarm=args.prewarm)
-        print(f"OpenWhisk-TPU standalone listening on :{args.port}")
+                                           prewarm=args.prewarm,
+                                           balancer=args.balancer)
+        print(f"OpenWhisk-TPU standalone listening on :{args.port} "
+              f"(balancer={args.balancer})")
         print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")
         print(f"  API      http://127.0.0.1:{args.port}/api/v1")
         try:

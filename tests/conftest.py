@@ -13,3 +13,9 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var; force the CPU
+# backend through the config API so tests never touch the tunneled chip.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
