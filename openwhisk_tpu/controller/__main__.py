@@ -1,0 +1,71 @@
+"""CLI: run a controller process against a bus + shared store.
+
+Rebuild of core/controller/.../Controller.scala main for distributed mode:
+REST API + a real balancer (TPU kernel or CPU sharding) fed by invoker
+health pings over the bus.
+
+  python -m openwhisk_tpu.controller --bus 127.0.0.1:4222 \
+      --db /path/whisks.db --port 3233 --balancer tpu \
+      --instance 0 --cluster-size 1
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from ..core.entity import ControllerInstanceId, ExecManifest, WhiskAuthRecord
+from ..database import SqliteArtifactStore
+from ..messaging.tcp import TcpMessagingProvider
+from ..utils.logging import Logging
+from .core import Controller
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="OpenWhisk-TPU controller")
+    parser.add_argument("--bus", default="127.0.0.1:4222")
+    parser.add_argument("--db", required=True)
+    parser.add_argument("--port", type=int, default=3233)
+    parser.add_argument("--instance", default="0")
+    parser.add_argument("--cluster-size", type=int, default=1)
+    parser.add_argument("--balancer", choices=("tpu", "sharding"), default="tpu")
+    parser.add_argument("--seed-guest", action="store_true",
+                        help="create the standalone guest identity")
+    args = parser.parse_args()
+
+    async def run():
+        logger = Logging(level="info")
+        ExecManifest.initialize()
+        host, _, port = args.bus.partition(":")
+        provider = TcpMessagingProvider(host, int(port or 4222))
+        store = SqliteArtifactStore(args.db)
+        instance = ControllerInstanceId(args.instance)
+        if args.balancer == "tpu":
+            from .loadbalancer.tpu_balancer import TpuBalancer
+            lb = TpuBalancer(provider, instance, logger=logger,
+                             metrics=logger.metrics,
+                             cluster_size=args.cluster_size)
+        else:
+            from .loadbalancer.sharding_balancer import ShardingBalancer
+            lb = ShardingBalancer(provider, instance, logger=logger,
+                                  metrics=logger.metrics,
+                                  cluster_size=args.cluster_size)
+        controller = Controller(instance, provider, artifact_store=store,
+                                logger=logger, load_balancer=lb)
+        if args.seed_guest:
+            from ..standalone import guest_identity
+            ident = guest_identity()
+            await controller.auth_store.put(
+                WhiskAuthRecord(ident.subject, [ident.namespace], [ident.authkey]))
+        await controller.start(port=args.port)
+        print(f"controller{args.instance} up on :{args.port} "
+              f"(balancer={args.balancer}, bus={args.bus})", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await controller.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

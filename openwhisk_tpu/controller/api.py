@@ -279,10 +279,15 @@ class ControllerApi:
             return _error(400, "malformed JSON body", request["transid"])
         action, pkg_params = await resolve_action(self.c.entity_store, fqn,
                                                   request["identity"])
+        from .conductors import is_conductor
         if action.is_sequence:
             outcome = await self.c.sequencer.invoke_sequence(
                 request["identity"], action, payload, blocking,
                 transid=request["transid"])
+        elif is_conductor(action):
+            outcome = await self.c.conductor.invoke_composition(
+                request["identity"], action, payload, blocking,
+                transid=request["transid"], package_params=pkg_params)
         else:
             outcome = await self.c.invoker.invoke(
                 request["identity"], action, pkg_params, payload, blocking,

@@ -52,13 +52,17 @@ class Controller:
         self.load_balancer = load_balancer
         self.entitlement = entitlement or LocalEntitlementProvider(
             load_balancer, invocations_per_minute, concurrent_invocations,
-            fires_per_minute, metrics=self.metrics)
+            fires_per_minute, metrics=self.metrics,
+            event_producer=messaging_provider.get_producer())
         self.action_sequence_limit = action_sequence_limit
         self.invoker = ActionInvoker(self.entity_store, self.activation_store,
                                      load_balancer, instance, self.logger)
         self.sequencer = SequenceInvoker(self.entity_store, self.activation_store,
                                          self.invoker, instance,
                                          action_sequence_limit)
+        from .conductors import ConductorInvoker
+        self.conductor = ConductorInvoker(self.entity_store, self.activation_store,
+                                          self.invoker, action_sequence_limit)
         self.trigger_service = TriggerService(self.entity_store,
                                               self.activation_store,
                                               self.invoker, self.sequencer)

@@ -91,9 +91,10 @@ class LocalEntitlementProvider:
                  concurrent_invocations: int = 30,
                  fires_per_minute: int = 60,
                  allowed_kinds: Optional[set] = None,
-                 metrics=None):
+                 metrics=None, event_producer=None):
         self.load_balancer = load_balancer
         self.metrics = metrics
+        self.event_producer = event_producer  # `events` topic (throttle events)
         self._grants: Dict[str, set] = {}
         cluster = max(1, getattr(load_balancer, "cluster_size", 1) or 1)
         per_instance = lambda n: max(1, int(n / cluster * self.OVERCOMMIT)) \
@@ -135,19 +136,19 @@ class LocalEntitlementProvider:
         limits = identity.limits
         if is_trigger_fire:
             if not self.fire_rate.check(ns_id, limits.fires_per_minute):
-                self._throttle_metric("firesPerMinute")
+                self._throttle_event("TimedRateLimit", identity)
                 raise ThrottleRejectRequest(
                     "Too many requests in the last minute (count: exceeded, "
                     "allowed: trigger fires per minute).")
         else:
             if not self.invoke_rate.check(ns_id, limits.invocations_per_minute):
-                self._throttle_metric("invocationsPerMinute")
+                self._throttle_event("TimedRateLimit", identity)
                 raise ThrottleRejectRequest(
                     "Too many requests in the last minute (count: exceeded, "
                     "allowed: invocations per minute).")
             if self.load_balancer is not None and \
                     not self.concurrent.check(ns_id, limits.concurrent_invocations):
-                self._throttle_metric("concurrentInvocations")
+                self._throttle_event("ConcurrentRateLimit", identity)
                 raise ThrottleRejectRequest(
                     "Too many concurrent requests in flight (count: exceeded, "
                     "allowed: concurrent invocations).")
@@ -158,6 +159,16 @@ class LocalEntitlementProvider:
         if allowed is not None and kind not in allowed:
             raise RejectRequest(f"action kind '{kind}' not allowed for this subject")
 
-    def _throttle_metric(self, which: str) -> None:
+    def _throttle_event(self, which: str, identity: Identity) -> None:
+        """Count + publish the user-facing throttle event
+        (ref Entitlement.scala:383-399 -> `events` topic)."""
         if self.metrics:
             self.metrics.counter(f"controller_throttle_{which}")
+        if self.event_producer is not None:
+            from ..messaging.message import EventMessage
+            from ..utils.tasks import spawn
+            spawn(self.event_producer.send(
+                "events", EventMessage.for_metric(
+                    "controller", which, 1, str(identity.subject),
+                    str(identity.namespace.name),
+                    identity.namespace.uuid.asString)), name="throttle-event")

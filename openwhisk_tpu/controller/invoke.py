@@ -70,6 +70,8 @@ class ActionInvoker:
         package < action < payload; the message carries only the payload-
         merged arguments."""
         transid = transid or TransactionId()
+        from ..utils.tracing import GLOBAL_TRACER
+        GLOBAL_TRACER.start_span("controller_activation", transid)
         args = package_params.merge(action.parameters).merge(
             Parameters.from_arguments(payload or {}))
         msg = ActivationMessage(
@@ -82,13 +84,19 @@ class ActionInvoker:
             blocking=blocking,
             content=args.to_arguments(),
             cause=cause,
+            trace_context=GLOBAL_TRACER.get_trace_context(transid),
         )
-        promise = await self.load_balancer.publish(action, msg)
-        if not blocking:
-            return InvokeOutcome(None, msg.activation_id, accepted=True)
-        wait = min(wait_override or MAX_BLOCKING_WAIT,
-                   action.limits.timeout.seconds + 60.0)
-        return await self._wait_for_response(identity, msg, promise, wait)
+        try:
+            promise = await self.load_balancer.publish(action, msg)
+            if not blocking:
+                return InvokeOutcome(None, msg.activation_id, accepted=True)
+            wait = min(wait_override or MAX_BLOCKING_WAIT,
+                       action.limits.timeout.seconds + 60.0)
+            return await self._wait_for_response(identity, msg, promise, wait)
+        finally:
+            GLOBAL_TRACER.finish_span(
+                transid, {"action": str(action.fully_qualified_name),
+                          "activationId": msg.activation_id.asString})
 
     async def _wait_for_response(self, identity: Identity, msg: ActivationMessage,
                                  promise: asyncio.Future, wait: float

@@ -296,6 +296,51 @@ class TpuBalancer(CommonLoadBalancer):
     async def invoker_health(self) -> List[InvokerHealth]:
         return self.supervision.health()
 
+    # -- checkpoint / resume (SURVEY §5.4) ---------------------------------
+    def snapshot(self) -> dict:
+        """Host-side snapshot of the device capacity matrix + registry. The
+        balancer state is soft (reconstructible from pings/acks), so this is
+        the whole checkpoint story: dump it periodically, restore on boot to
+        skip the warm-up window."""
+        conc = np.asarray(self.state.conc_free)
+        nz = np.nonzero(conc)
+        return {
+            "n_pad": self._n_pad,
+            "cluster_size": self._cluster_size,
+            "registry": [inv.to_json() for inv in self._registry],
+            "healthy": list(self._healthy),
+            "free_mb": np.asarray(self.state.free_mb).tolist(),
+            "conc_nonzero": [[int(i), int(j), int(conc[i, j])]
+                             for i, j in zip(*nz)],
+            "slots": dict(self._slots.slots),
+            "slot_refcount": dict(self._slots.refcount),
+        }
+
+    def restore(self, snap: dict) -> None:
+        self._n_pad = int(snap["n_pad"])
+        self._cluster_size = int(snap["cluster_size"])
+        self._registry = [InvokerInstanceId.from_json(j)
+                          for j in snap["registry"]]
+        self._healthy = [bool(h) for h in snap["healthy"]]
+        free = np.asarray(snap["free_mb"], np.int32)
+        conc = np.zeros((self._n_pad, self.action_slots), np.int32)
+        for i, j, v in snap.get("conc_nonzero", []):
+            conc[i, j] = v
+        health = np.zeros((self._n_pad,), bool)
+        health[: len(self._healthy)] = self._healthy
+        state = PlacementState(jnp.asarray(free), jnp.asarray(conc),
+                               jnp.asarray(health))
+        if self.mesh is not None:
+            from ...parallel.sharded_state import shard_state
+            state = shard_state(state, self.mesh)
+        self.state = state
+        self._slots.slots = dict(snap.get("slots", {}))
+        self._slots.refcount = dict(snap.get("slot_refcount", {}))
+        used = set(self._slots.slots.values())
+        self._slots.free = [s for s in range(self.action_slots - 1, -1, -1)
+                            if s not in used]
+        self._recompute_partitions()
+
     # -- the device step ---------------------------------------------------
     def _arm_flush(self, urgent: bool = False) -> None:
         if self._flush_task is None or self._flush_task.done():

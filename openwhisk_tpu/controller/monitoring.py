@@ -1,0 +1,121 @@
+"""User-events monitoring service.
+
+Rebuild of core/monitoring/user-events (OpenWhiskEvents.start :34-66,
+EventConsumer.scala, PrometheusRecorder.scala): consume the `events` topic
+and translate Activation/Metric event bodies into Prometheus series —
+per-action activation counts, status-code counts, duration/waitTime/initTime
+sums, cold-start counts, and namespace-level throttle counters. Runs either
+embedded in a controller or as its own process
+(`python -m openwhisk_tpu.controller.monitoring --bus ...`).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..messaging.connector import MessageFeed
+from ..messaging.message import EventMessage
+from ..utils.logging import MetricEmitter
+
+EVENTS_TOPIC = "events"
+
+
+class UserEventsRecorder:
+    def __init__(self, messaging_provider, metrics: Optional[MetricEmitter] = None,
+                 logger=None, group: str = "user-events"):
+        self.provider = messaging_provider
+        self.metrics = metrics or MetricEmitter()
+        self.logger = logger
+        self.group = group
+        self._feed: Optional[MessageFeed] = None
+
+    def start(self) -> None:
+        self.provider.ensure_topic(EVENTS_TOPIC)
+        consumer = self.provider.get_consumer(EVENTS_TOPIC, self.group, max_peek=256)
+        box = {}
+
+        async def handle(payload: bytes):
+            try:
+                self.record(EventMessage.parse(payload))
+            except (ValueError, KeyError):
+                pass
+            box["feed"].processed()
+
+        self._feed = MessageFeed("user-events", consumer, 256, handle,
+                                 logger=self.logger)
+        box["feed"] = self._feed
+        self._feed.start()
+
+    def record(self, event: EventMessage) -> None:
+        """PrometheusRecorder.scala semantics: one series family per metric,
+        action-scoped for activations, namespace-scoped for throttles."""
+        if event.event_type == "Activation":
+            b = event.body
+            name = b.get("name", "unknown").replace("/", "_")
+            self.metrics.counter(f"userevents_activations_{name}_total")
+            self.metrics.counter(
+                f"userevents_activations_{name}_status_{b.get('statusCode', 0)}")
+            self.metrics.histogram(f"userevents_duration_{name}_ms",
+                                   b.get("duration", 0))
+            if b.get("waitTime"):
+                self.metrics.histogram(f"userevents_waitTime_{name}_ms",
+                                       b["waitTime"])
+            if b.get("initTime"):
+                self.metrics.histogram(f"userevents_initTime_{name}_ms",
+                                       b["initTime"])
+                self.metrics.counter(f"userevents_coldStarts_{name}_total")
+            self.metrics.gauge("userevents_memory_" + name, b.get("memory", 0))
+        elif event.event_type == "Metric":
+            b = event.body
+            ns = event.namespace.replace("/", "_")
+            self.metrics.counter(
+                f"userevents_{b.get('metricName', 'unknown')}_{ns}",
+                int(b.get("metricValue", 1)))
+
+    def prometheus_text(self) -> str:
+        return self.metrics.prometheus_text()
+
+    async def stop(self) -> None:
+        if self._feed:
+            await self._feed.stop()
+
+
+def main() -> None:
+    import argparse
+
+    from aiohttp import web
+
+    from ..messaging.tcp import TcpMessagingProvider
+
+    parser = argparse.ArgumentParser(description="user-events monitoring")
+    parser.add_argument("--bus", default="127.0.0.1:4222")
+    parser.add_argument("--port", type=int, default=9096)
+    args = parser.parse_args()
+
+    async def run():
+        host, _, port = args.bus.partition(":")
+        provider = TcpMessagingProvider(host, int(port or 4222))
+        recorder = UserEventsRecorder(provider)
+        recorder.start()
+
+        async def metrics_handler(request):
+            return web.Response(text=recorder.prometheus_text(),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics_handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "0.0.0.0", args.port).start()
+        print(f"user-events metrics on :{args.port}/metrics", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await recorder.stop()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

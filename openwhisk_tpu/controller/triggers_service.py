@@ -56,6 +56,10 @@ class TriggerService:
 
     async def _fire_rule(self, identity, rule_name, rule, args, cause, transid) -> str:
         import json
+
+        # each fired rule gets its own transaction id: the rules run
+        # concurrently and the tracer's span stack is per-transid
+        transid = TransactionId()
         try:
             action, pkg_params = await resolve_action(
                 self.entity_store, rule.action.resolve(str(identity.namespace.name)),

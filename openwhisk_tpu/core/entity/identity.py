@@ -76,9 +76,11 @@ class Identity:
 
     @classmethod
     def generate(cls, name: str) -> "Identity":
+        # one uuid identifies both the namespace and the credential — the
+        # reference's WhiskNamespace carries the authkey's uuid
+        key = BasicAuthenticationAuthKey.generate()
         return cls(Subject(name if len(name) >= 5 else name + "-user"),
-                   Namespace(EntityName(name), UUID.generate()),
-                   BasicAuthenticationAuthKey.generate())
+                   Namespace(EntityName(name), key.uuid), key)
 
     @property
     def namespace_path(self) -> EntityPath:
@@ -107,14 +109,18 @@ class Identity:
 @dataclass
 class WhiskAuthRecord:
     """Subject document in the auth store: a subject owning one or more
-    namespaces (ref WhiskAuth/WhiskNamespace in Identity.scala)."""
+    namespaces (ref WhiskAuth/WhiskNamespace in Identity.scala), each with
+    optional per-namespace limit overrides (the reference stores these as
+    separate `<ns>/limits` documents; here they ride on the record)."""
     subject: Subject
     namespaces: List[Namespace]
     keys: List[BasicAuthenticationAuthKey]
     blocked: bool = False
+    limits: dict = field(default_factory=dict)  # namespace name -> UserLimits
 
     def identities(self) -> List[Identity]:
-        return [Identity(self.subject, ns, k)
+        return [Identity(self.subject, ns, k,
+                         limits=self.limits.get(str(ns.name), UserLimits()))
                 for ns, k in zip(self.namespaces, self.keys)]
 
     def to_json(self):
@@ -125,6 +131,7 @@ class WhiskAuthRecord:
                 for ns, k in zip(self.namespaces, self.keys)
             ],
             "blocked": self.blocked,
+            "limits": {ns: l.to_json() for ns, l in self.limits.items()},
         }
 
     @classmethod
@@ -133,4 +140,7 @@ class WhiskAuthRecord:
         for n in j.get("namespaces", []):
             nss.append(Namespace(EntityName(n["name"]), UUID(n["uuid"])))
             keys.append(BasicAuthenticationAuthKey(UUID(n["uuid"]), Secret(n["key"])))
-        return cls(Subject(j["subject"]), nss, keys, bool(j.get("blocked", False)))
+        limits = {ns: UserLimits.from_json(l)
+                  for ns, l in (j.get("limits") or {}).items()}
+        return cls(Subject(j["subject"]), nss, keys, bool(j.get("blocked", False)),
+                   limits)

@@ -24,6 +24,10 @@ _TYPES = {
 
 
 class EntityStore:
+    # action code above this inlining threshold is stored as an attachment
+    # (ref WhiskAction CodeExecAsAttachment + AttachmentStore SPI)
+    ATTACHMENT_THRESHOLD = 64 * 1024
+
     def __init__(self, store: ArtifactStore, cache: Optional[EntityCache] = None,
                  on_invalidate: Optional[Callable] = None):
         self.store = store
@@ -36,6 +40,19 @@ class EntityStore:
 
     async def put(self, entity: WhiskEntity) -> DocRevision:
         doc = entity.to_document()
+        attachment = None
+        exec_json = doc.get("exec")
+        if isinstance(exec_json, dict):
+            code = exec_json.get("code")
+            if isinstance(code, str) and len(code) > self.ATTACHMENT_THRESHOLD:
+                attachment = code.encode()
+                exec_json["code"] = {"attachmentName": "codefile",
+                                     "attachmentType": "text/plain"}
+        # attachment FIRST: a reader (or crash) between the two writes must
+        # never see a stub document whose attachment does not exist yet
+        if attachment is not None:
+            await self.store.attach(entity.docid, "codefile", "text/plain",
+                                    attachment)
         rev = await self.store.put(entity.docid, doc,
                                    entity.rev.rev if not entity.rev.empty else None)
         entity.rev = DocRevision(rev)
@@ -46,6 +63,11 @@ class EntityStore:
     async def get(self, cls: Type, doc_id: str, use_cache: bool = True):
         async def load():
             doc = await self.store.get(doc_id)
+            exec_json = doc.get("exec")
+            if isinstance(exec_json, dict) and isinstance(exec_json.get("code"), dict):
+                _, data = await self.store.read_attachment(
+                    doc_id, exec_json["code"].get("attachmentName", "codefile"))
+                exec_json["code"] = data.decode()
             ent = cls.from_json(doc)
             ent.rev = DocRevision(doc.get("_rev"))
             return ent

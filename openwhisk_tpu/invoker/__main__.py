@@ -1,0 +1,73 @@
+"""CLI: run a standalone invoker process against a bus + shared store.
+
+Rebuild of core/invoker/.../Invoker.scala main: connect to the bus, claim a
+stable instance id for --unique-name (store-backed CAS, no Zookeeper), start
+the container pool and the activation feed, ping health at 1 Hz.
+
+  python -m openwhisk_tpu.invoker --bus 127.0.0.1:4222 --db /path/whisks.db \
+      --unique-name invoker-a --memory 2048
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from ..containerpool import ContainerPoolConfig
+from ..containerpool.process_factory import ProcessContainerFactory
+from ..core.entity import ExecManifest, InvokerInstanceId, MB
+from ..database import ArtifactActivationStore, EntityStore, SqliteArtifactStore
+from ..messaging.tcp import TcpMessagingProvider
+from ..utils.logging import Logging
+from .id_assigner import InstanceIdAssigner
+from .reactive import InvokerReactive
+from .server import InvokerServer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="OpenWhisk-TPU invoker")
+    parser.add_argument("--bus", default="127.0.0.1:4222", help="broker host:port")
+    parser.add_argument("--db", required=True, help="shared sqlite store path")
+    parser.add_argument("--unique-name", required=True,
+                        help="stable name; maps to a persistent invoker id")
+    parser.add_argument("--id", type=int, default=None,
+                        help="force this invoker id (overrides assignment)")
+    parser.add_argument("--memory", type=int, default=2048, help="user memory MB")
+    parser.add_argument("--port", type=int, default=0, help="liveness /ping port")
+    parser.add_argument("--prewarm", action="store_true")
+    args = parser.parse_args()
+
+    async def run():
+        logger = Logging(level="info")
+        ExecManifest.initialize()
+        host, _, port = args.bus.partition(":")
+        provider = TcpMessagingProvider(host, int(port or 4222))
+        store = SqliteArtifactStore(args.db)
+        instance_id = await InstanceIdAssigner(store).assign(
+            args.unique_name, args.id)
+        instance = InvokerInstanceId(instance_id, unique_name=args.unique_name,
+                                     user_memory=MB(args.memory))
+        invoker = InvokerReactive(
+            instance, provider, EntityStore(store),
+            ArtifactActivationStore(store), ProcessContainerFactory(logger=logger),
+            pool_config=ContainerPoolConfig(user_memory=MB(args.memory),
+                                            pause_grace=1.0),
+            logger=logger)
+        await invoker.start(start_prewarm=args.prewarm)
+        server = None
+        if args.port:
+            server = InvokerServer(invoker, args.port)
+            await server.start()
+        print(f"invoker{instance_id} ({args.unique_name}) up — bus {args.bus}, "
+              f"memory {args.memory}MB", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            if server:
+                await server.stop()
+            await invoker.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,10 @@ class InvokerReactive:
         self._feed: Optional[MessageFeed] = None
         self._pinger: Optional[Scheduler] = None
         self._pending_release: dict = {}
+        from ..database import AuthStore
+        from .blacklist import NamespaceBlacklist
+        self.blacklist = NamespaceBlacklist(AuthStore(entity_store.store))
+        self._blacklist_poller: Optional[Scheduler] = None
 
     # -- capacity: maxPeek mirrors ref :172-173 -----------------------------
     def max_peek(self) -> int:
@@ -90,11 +94,17 @@ class InvokerReactive:
         self._feed.start()
         self._pinger = Scheduler(self.ping_interval, self._ping,
                                  name=f"{topic}-pinger", logger=self.logger).start()
+        self._blacklist_poller = Scheduler(
+            300.0, self.blacklist.refresh, name=f"{topic}-blacklist",
+            logger=self.logger).start()
+        await self.blacklist.refresh()
 
     async def _ping(self) -> None:
         await self.producer.send(HEALTH_TOPIC, PingMessage(self.instance))
 
     async def stop(self) -> None:
+        if self._blacklist_poller:
+            await self._blacklist_poller.stop()
         if self._pinger:
             await self._pinger.stop()
         if self._feed:
@@ -120,6 +130,15 @@ class InvokerReactive:
                                   f"corrupt activation message: {e!r}", "InvokerReactive")
             release()
             return
+        from ..utils.tracing import GLOBAL_TRACER
+        GLOBAL_TRACER.set_trace_context(msg.transid, msg.trace_context)
+        GLOBAL_TRACER.start_span("invoker_activation", msg.transid)
+        if self.blacklist.is_blacklisted(msg.user):
+            await self._error_activation(
+                msg, "Namespace is disabled.")
+            GLOBAL_TRACER.clear(msg.transid)
+            release()
+            return
         try:
             action = await self.entity_store.get_action(str(msg.action))
             executable = action.to_executable()
@@ -131,11 +150,13 @@ class InvokerReactive:
             self.pool.run(Run(executable, msg))
         except NoDocumentException:
             await self._error_activation(msg, "The requested resource does not exist.")
+            GLOBAL_TRACER.clear(msg.transid)
             release()
         except Exception as e:  # noqa: BLE001 — invoker loop must survive
             if self.logger:
                 self.logger.error(msg.transid, f"activation failed: {e!r}", "InvokerReactive")
             await self._error_activation(msg, f"Invoker error: {e}")
+            GLOBAL_TRACER.clear(msg.transid)
             release()
 
     # -- proxy wiring ------------------------------------------------------
@@ -157,6 +178,24 @@ class InvokerReactive:
             message = CombinedCompletionAndResultMessage(transid, activation,
                                                          self.instance)
         await self.producer.send(topic, message.shrink())
+        if kind != "result":
+            # final ack: publish the user-facing activation event
+            # (ref InvokerReactive.scala:182-185 -> `events` topic)
+            await self._emit_activation_event(activation, user)
+
+    async def _emit_activation_event(self, activation: WhiskActivation, user) -> None:
+        from ..messaging.message import EventMessage
+        try:
+            annotations = activation.annotations
+            await self.producer.send("events", EventMessage.for_activation(
+                self.instance.as_string, activation,
+                user.namespace.uuid.asString,
+                kind=annotations.get("kind", "unknown"),
+                memory_mb=(annotations.get("limits") or {}).get("memory", 256),
+                wait_time=annotations.get("waitTime", 0) or 0,
+                init_time=annotations.get("initTime", 0) or 0))
+        except Exception:  # noqa: BLE001 — events are best-effort telemetry
+            pass
 
     async def _store_hook(self, transid, activation, user) -> None:
         try:
@@ -165,6 +204,12 @@ class InvokerReactive:
             release = self._pending_release.pop(activation.activation_id.asString, None)
             if release is not None:
                 release()
+            # report the invoker span and drop the restored remote parent
+            # (unfinished stacks would otherwise accumulate per transid)
+            from ..utils.tracing import GLOBAL_TRACER
+            GLOBAL_TRACER.finish_span(transid, {
+                "activationId": activation.activation_id.asString})
+            GLOBAL_TRACER.clear(transid)
 
     async def _store_activation(self, transid, activation, user) -> None:
         try:

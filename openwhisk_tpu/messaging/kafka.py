@@ -1,0 +1,111 @@
+"""Kafka backend (gated).
+
+Rebuild of the reference's connector/kafka (KafkaMessagingProvider /
+KafkaConsumerConnector / KafkaProducerConnector): topics with per-topic
+retention, long-poll peek, commit-after-peek. Requires `aiokafka` (or
+`kafka-python`), which is not part of this image — the provider raises a
+clear error when the client library is absent; deployments with Kafka
+install the client and select this provider via the MessagingProvider SPI
+(CONFIG_whisk_spi_MessagingProvider=openwhisk_tpu.messaging.kafka:KafkaMessagingProvider).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .connector import MessageConsumer, MessageProducer, MessagingProvider
+
+try:
+    import aiokafka  # type: ignore[import-not-found]
+    HAVE_KAFKA = True
+except ImportError:
+    aiokafka = None
+    HAVE_KAFKA = False
+
+# payload cap mirrors the reference: 1 MB + serdes overhead
+# (application.conf:337-366)
+MAX_REQUEST_SIZE = 1024 * 1024 + 6144
+
+
+def _require_kafka() -> None:
+    if not HAVE_KAFKA:
+        raise RuntimeError(
+            "Kafka backend selected but no kafka client library is installed "
+            "(need aiokafka). Use the TCP bus (openwhisk_tpu.messaging.tcp) "
+            "or the in-memory bus instead.")
+
+
+class KafkaProducer(MessageProducer):
+    def __init__(self, bootstrap: str):
+        _require_kafka()
+        self._producer = aiokafka.AIOKafkaProducer(
+            bootstrap_servers=bootstrap, max_request_size=MAX_REQUEST_SIZE,
+            acks="all")
+        self._started = False
+        self._sent = 0
+
+    @property
+    def sent_count(self) -> int:
+        return self._sent
+
+    async def send(self, topic: str, msg) -> None:
+        if not self._started:
+            await self._producer.start()
+            self._started = True
+        payload = msg if isinstance(msg, (bytes, bytearray)) else msg.serialize()
+        await self._producer.send_and_wait(topic, bytes(payload))
+        self._sent += 1
+
+    async def close(self) -> None:
+        if self._started:
+            await self._producer.stop()
+
+
+class KafkaConsumer(MessageConsumer):
+    def __init__(self, bootstrap: str, topic: str, group: str, max_peek: int = 128):
+        _require_kafka()
+        self.topic = topic
+        self.max_peek = max_peek
+        self._consumer = aiokafka.AIOKafkaConsumer(
+            topic, bootstrap_servers=bootstrap, group_id=group,
+            enable_auto_commit=False, auto_offset_reset="earliest")
+        self._started = False
+
+    async def peek(self, max_messages: int, timeout: float = 0.5
+                   ) -> List[Tuple[str, int, int, bytes]]:
+        if not self._started:
+            await self._consumer.start()
+            self._started = True
+        batches = await self._consumer.getmany(
+            timeout_ms=int(timeout * 1000),
+            max_records=min(max_messages, self.max_peek))
+        out = []
+        for tp, records in batches.items():
+            for r in records:
+                out.append((r.topic, r.partition, r.offset, r.value))
+        return out
+
+    def commit(self) -> None:
+        if self._started:
+            from ..utils.tasks import spawn
+            spawn(self._consumer.commit(), name="kafka-commit")
+
+    async def close(self) -> None:
+        if self._started:
+            await self._consumer.stop()
+
+
+class KafkaMessagingProvider(MessagingProvider):
+    def __init__(self, bootstrap: str = "localhost:9092"):
+        _require_kafka()
+        self.bootstrap = bootstrap
+
+    def get_producer(self) -> KafkaProducer:
+        return KafkaProducer(self.bootstrap)
+
+    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128
+                     ) -> KafkaConsumer:
+        return KafkaConsumer(self.bootstrap, topic, group_id, max_peek)
+
+    def ensure_topic(self, topic: str, partitions: int = 1,
+                     retention_bytes: Optional[int] = None) -> None:
+        pass  # auto-create via broker config; admin-client creation optional

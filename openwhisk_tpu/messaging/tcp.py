@@ -1,0 +1,238 @@
+"""TCP bus: the framework's own distributed messaging spine.
+
+The reference's data plane rides Kafka (SURVEY §5.8); this module provides
+the framework-native equivalent for multi-process/multi-host deployments
+without external brokers: a lightweight asyncio broker (`TcpBusServer`)
+serving the same topic/consumer-group semantics as the in-memory bus over
+length-prefixed JSON frames, and `TcpMessagingProvider` implementing the
+MessagingProvider SPI against it. Kafka itself remains pluggable behind the
+same SPI (messaging/kafka.py, gated on client availability).
+
+Protocol (4-byte big-endian length + JSON):
+  {"op": "pub",  "topic": t, "payload": <b64>}            -> {"ok": true}
+  {"op": "peek", "topic": t, "group": g, "max": n,
+   "timeout": s}   -> {"msgs": [[offset, <b64>], ...]}    (long-poll)
+  {"op": "ensure", "topic": t}                            -> {"ok": true}
+Delivery is at-most-once per group, exactly like the reference's
+commit-after-peek hand-off (MessageConsumer.scala:179-190).
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import struct
+from typing import List, Optional, Tuple
+
+from .connector import MessageConsumer, MessageProducer, MessagingProvider
+from .memory import MemoryBus
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = struct.unpack(">I", header)
+    if length > 64 * 1024 * 1024:
+        return None
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return json.loads(body)
+
+
+def _frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    return struct.pack(">I", len(body)) + body
+
+
+class TcpBusServer:
+    """The broker: topic queues (a MemoryBus) served over TCP."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 4222):
+        self.host = host
+        self.port = port
+        self.bus = MemoryBus()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._client_writers: set = set()
+        self._seen_mids: dict = {}  # LRU of recent pub message ids (dedupe)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            # sever live client connections: wait_closed() (py3.12) waits for
+            # all handlers, which block in reads on long-lived clients
+            for w in list(self._client_writers):
+                w.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        from .memory import MemoryConsumer, MemoryProducer
+        producer = MemoryProducer(self.bus)
+        consumers = {}
+        self._client_writers.add(writer)
+        try:
+            while True:
+                req = await _read_frame(reader)
+                if req is None:
+                    break
+                op = req.get("op")
+                if op == "pub":
+                    # dedupe on the client message id: a producer retries a
+                    # pub whose response was lost, and activations must not
+                    # run twice because of a dropped TCP ack
+                    mid = req.get("mid")
+                    if mid is not None and mid in self._seen_mids:
+                        writer.write(_frame({"ok": True, "dup": True}))
+                    else:
+                        if mid is not None:
+                            self._seen_mids[mid] = None
+                            if len(self._seen_mids) > 8192:
+                                self._seen_mids.pop(next(iter(self._seen_mids)))
+                        payload = base64.b64decode(req["payload"])
+                        await producer.send(req["topic"], payload)
+                        writer.write(_frame({"ok": True}))
+                elif op == "peek":
+                    key = (req["topic"], req.get("group", "default"))
+                    consumer = consumers.get(key)
+                    if consumer is None:
+                        consumer = MemoryConsumer(self.bus, key[0], key[1],
+                                                  max_peek=1024)
+                        consumers[key] = consumer
+                    batch = await consumer.peek(int(req.get("max", 128)),
+                                                float(req.get("timeout", 0.5)))
+                    consumer.commit()
+                    writer.write(_frame({"msgs": [
+                        [off, base64.b64encode(p).decode()]
+                        for (_t, _p, off, p) in batch]}))
+                elif op == "ensure":
+                    self.bus.topic(req["topic"])
+                    writer.write(_frame({"ok": True}))
+                else:
+                    writer.write(_frame({"error": f"unknown op {op!r}"}))
+                await writer.drain()
+        finally:
+            self._client_writers.discard(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, OSError):
+                pass
+
+
+class _TcpConnection:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    async def request(self, obj: dict) -> dict:
+        async with self._lock:
+            for attempt in (1, 2):
+                if self.writer is None or self.writer.is_closing():
+                    self.reader, self.writer = await asyncio.open_connection(
+                        self.host, self.port)
+                try:
+                    self.writer.write(_frame(obj))
+                    await self.writer.drain()
+                    resp = await _read_frame(self.reader)
+                    if resp is not None:
+                        return resp
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass
+                # reconnect once
+                self.writer = None
+            raise ConnectionError(f"bus at {self.host}:{self.port} unreachable")
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except (ConnectionResetError, OSError):
+                pass
+            self.writer = None
+
+
+class TcpProducer(MessageProducer):
+    def __init__(self, host: str, port: int):
+        self._conn = _TcpConnection(host, port)
+        self._sent = 0
+
+    @property
+    def sent_count(self) -> int:
+        return self._sent
+
+    async def send(self, topic: str, msg) -> None:
+        import uuid
+        payload = msg if isinstance(msg, (bytes, bytearray)) else msg.serialize()
+        # one mid per logical send: a connection-retry of the same frame is
+        # deduped broker-side, keeping pub effectively-once
+        await self._conn.request({"op": "pub", "topic": topic,
+                                  "mid": uuid.uuid4().hex,
+                                  "payload": base64.b64encode(bytes(payload)).decode()})
+        self._sent += 1
+
+    async def close(self) -> None:
+        await self._conn.close()
+
+
+class TcpConsumer(MessageConsumer):
+    def __init__(self, host: str, port: int, topic: str, group: str,
+                 max_peek: int = 128):
+        self._conn = _TcpConnection(host, port)
+        self.topic = topic
+        self.group = group
+        self.max_peek = max_peek
+
+    async def peek(self, max_messages: int, timeout: float = 0.5
+                   ) -> List[Tuple[str, int, int, bytes]]:
+        try:
+            resp = await self._conn.request({
+                "op": "peek", "topic": self.topic, "group": self.group,
+                "max": min(max_messages, self.max_peek), "timeout": timeout})
+        except ConnectionError:
+            await asyncio.sleep(timeout)
+            return []
+        return [(self.topic, 0, off, base64.b64decode(p))
+                for off, p in resp.get("msgs", [])]
+
+    def commit(self) -> None:
+        pass  # the broker commits at peek (at-most-once), like the reference
+
+    async def close(self) -> None:
+        await self._conn.close()
+
+
+class TcpMessagingProvider(MessagingProvider):
+    def __init__(self, host: str = "127.0.0.1", port: int = 4222):
+        self.host = host
+        self.port = port
+        self._admin = _TcpConnection(host, port)
+
+    def get_producer(self) -> TcpProducer:
+        return TcpProducer(self.host, self.port)
+
+    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128
+                     ) -> TcpConsumer:
+        return TcpConsumer(self.host, self.port, topic, group_id, max_peek)
+
+    def ensure_topic(self, topic: str, partitions: int = 1,
+                     retention_bytes: Optional[int] = None) -> None:
+        # fire-and-forget from sync context; topics auto-create on first use
+        from ..utils.tasks import spawn
+        try:
+            loop = asyncio.get_event_loop()
+            if loop.is_running():
+                spawn(self._admin.request({"op": "ensure", "topic": topic}),
+                      name=f"ensure-{topic}")
+        except RuntimeError:
+            pass

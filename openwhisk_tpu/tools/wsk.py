@@ -1,0 +1,186 @@
+"""wsk: the user CLI, speaking the REST API.
+
+The framework's counterpart of the reference's `wsk` client (driven in its
+system tests via WskCliOperations): action/trigger/rule/package/activation
+operations over /api/v1.
+
+  export WSK_APIHOST=http://127.0.0.1:3233 WSK_AUTH=<uuid>:<key>
+  python -m openwhisk_tpu.tools.wsk action create hello hello.py
+  python -m openwhisk_tpu.tools.wsk action invoke hello -p name TPU -b -r
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import os
+import sys
+
+import aiohttp
+
+
+class WskClient:
+    def __init__(self, apihost: str, auth: str):
+        self.base = apihost.rstrip("/") + "/api/v1"
+        self.headers = {
+            "Authorization": "Basic " + base64.b64encode(auth.encode()).decode(),
+            "Content-Type": "application/json",
+        }
+
+    async def request(self, method: str, path: str, body=None, params=None):
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.request(method, self.base + path, json=body,
+                                     params=params or {}, headers=self.headers) as r:
+                    try:
+                        data = await r.json()
+                    except aiohttp.ContentTypeError:
+                        data = {"raw": await r.text()}
+                    return r.status, data
+        except aiohttp.ClientConnectionError as e:
+            return 503, {"error": f"cannot reach API host {self.base}: {e}"}
+
+
+def _params_to_dict(pairs):
+    out = {}
+    for k, v in pairs or []:
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
+def _kv_list(d):
+    return [{"key": k, "value": v} for k, v in d.items()]
+
+
+async def run(args) -> int:
+    apihost = args.apihost or os.environ.get("WSK_APIHOST", "http://127.0.0.1:3233")
+    auth = args.auth or os.environ.get("WSK_AUTH", "")
+    if not auth:
+        print("error: no credentials (--auth or WSK_AUTH)", file=sys.stderr)
+        return 2
+    client = WskClient(apihost, auth)
+    ns = "_"
+
+    def show(status, data):
+        print(json.dumps(data, indent=2))
+        return 0 if status < 400 else 1
+
+    e = args.entity
+    if e == "action":
+        if args.cmd in ("create", "update"):
+            code = open(args.artifact).read()
+            kind = args.kind or ("python:3" if args.artifact.endswith(".py")
+                                 else "nodejs:14")
+            body = {"exec": {"kind": kind, "code": code},
+                    "parameters": _kv_list(_params_to_dict(args.param)),
+                    "annotations": _kv_list(_params_to_dict(args.annotation))}
+            if args.web:
+                body["annotations"].append({"key": "web-export", "value": True})
+            if args.memory:
+                body.setdefault("limits", {})["memory"] = args.memory
+            if args.timeout:
+                body.setdefault("limits", {})["timeout"] = args.timeout
+            params = {"overwrite": "true"} if args.cmd == "update" else {}
+            return show(*await client.request(
+                "PUT", f"/namespaces/{ns}/actions/{args.name}", body, params))
+        if args.cmd == "invoke":
+            params = {}
+            if args.blocking:
+                params["blocking"] = "true"
+            if args.result:
+                params["result"] = "true"
+            return show(*await client.request(
+                "POST", f"/namespaces/{ns}/actions/{args.name}",
+                _params_to_dict(args.param), params))
+        if args.cmd == "get":
+            return show(*await client.request(
+                "GET", f"/namespaces/{ns}/actions/{args.name}"))
+        if args.cmd == "delete":
+            return show(*await client.request(
+                "DELETE", f"/namespaces/{ns}/actions/{args.name}"))
+        if args.cmd == "list":
+            return show(*await client.request("GET", f"/namespaces/{ns}/actions"))
+    elif e == "activation":
+        if args.cmd == "list":
+            return show(*await client.request(
+                "GET", f"/namespaces/{ns}/activations",
+                params={"limit": str(args.limit)}))
+        if args.cmd in ("get", "logs", "result"):
+            suffix = "" if args.cmd == "get" else f"/{args.cmd}"
+            return show(*await client.request(
+                "GET", f"/namespaces/{ns}/activations/{args.name}{suffix}"))
+    elif e == "trigger":
+        if args.cmd in ("create", "update"):
+            body = {"parameters": _kv_list(_params_to_dict(args.param))}
+            params = {"overwrite": "true"} if args.cmd == "update" else {}
+            return show(*await client.request(
+                "PUT", f"/namespaces/{ns}/triggers/{args.name}", body, params))
+        if args.cmd == "fire":
+            return show(*await client.request(
+                "POST", f"/namespaces/{ns}/triggers/{args.name}",
+                _params_to_dict(args.param)))
+        if args.cmd in ("get", "delete", "list"):
+            method = {"get": "GET", "delete": "DELETE", "list": "GET"}[args.cmd]
+            path = f"/namespaces/{ns}/triggers" + \
+                ("" if args.cmd == "list" else f"/{args.name}")
+            return show(*await client.request(method, path))
+    elif e == "rule":
+        if args.cmd == "create":
+            return show(*await client.request(
+                "PUT", f"/namespaces/{ns}/rules/{args.name}",
+                {"trigger": f"_/{args.trigger}", "action": f"_/{args.action}"}))
+        if args.cmd in ("enable", "disable"):
+            status = "active" if args.cmd == "enable" else "inactive"
+            return show(*await client.request(
+                "POST", f"/namespaces/{ns}/rules/{args.name}", {"status": status}))
+        if args.cmd in ("get", "delete", "list"):
+            method = {"get": "GET", "delete": "DELETE", "list": "GET"}[args.cmd]
+            path = f"/namespaces/{ns}/rules" + \
+                ("" if args.cmd == "list" else f"/{args.name}")
+            return show(*await client.request(method, path))
+    elif e == "package":
+        if args.cmd in ("create", "update"):
+            body = {"parameters": _kv_list(_params_to_dict(args.param))}
+            params = {"overwrite": "true"} if args.cmd == "update" else {}
+            return show(*await client.request(
+                "PUT", f"/namespaces/{ns}/packages/{args.name}", body, params))
+        if args.cmd in ("get", "delete", "list"):
+            method = {"get": "GET", "delete": "DELETE", "list": "GET"}[args.cmd]
+            path = f"/namespaces/{ns}/packages" + \
+                ("" if args.cmd == "list" else f"/{args.name}")
+            return show(*await client.request(method, path))
+    print("unknown command", file=sys.stderr)
+    return 2
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="wsk", description="OpenWhisk-TPU CLI")
+    parser.add_argument("--apihost", default=None)
+    parser.add_argument("--auth", "-u", default=None)
+    parser.add_argument("entity", choices=("action", "activation", "trigger",
+                                           "rule", "package"))
+    parser.add_argument("cmd")
+    parser.add_argument("name", nargs="?")
+    parser.add_argument("artifact", nargs="?")
+    parser.add_argument("--param", "-p", nargs=2, action="append", metavar=("K", "V"))
+    parser.add_argument("--annotation", "-a", nargs=2, action="append",
+                        metavar=("K", "V"))
+    parser.add_argument("--kind", default=None)
+    parser.add_argument("--web", action="store_true")
+    parser.add_argument("--memory", "-m", type=int, default=None)
+    parser.add_argument("--timeout", "-t", type=int, default=None)
+    parser.add_argument("--blocking", "-b", action="store_true")
+    parser.add_argument("--result", "-r", action="store_true")
+    parser.add_argument("--limit", "-l", type=int, default=30)
+    parser.add_argument("--trigger", default=None, help="rule create: trigger name")
+    parser.add_argument("--action", default=None, help="rule create: action name")
+    args = parser.parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""wskadmin: operator CLI for subjects, limits and the database.
+
+Rebuild of the reference's bin/wskadmin + tools/admin (WhiskAdmin):
+  user create/get/delete/list/block/unblock  — subject + namespace management
+  limits set/get/delete                      — per-namespace overrides
+  db get                                     — raw document dump
+
+Operates directly on the store (like the reference; no controller needed):
+  python -m openwhisk_tpu.tools.wskadmin --db whisks.db user create alice
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..core.entity import (BasicAuthenticationAuthKey, EntityName, Identity,
+                           Namespace, Subject, UserLimits, UUID, WhiskAuthRecord)
+from ..database import AuthStore, SqliteArtifactStore
+
+
+async def _user_create(store: AuthStore, args) -> int:
+    existing = await store.identity_by_namespace(args.subject)
+    if existing is not None and not args.namespace:
+        print("subject already exists", file=sys.stderr)
+        return 1
+    subject = Subject(args.subject if len(args.subject) >= 5
+                      else args.subject + "-user")
+    ns_name = args.namespace or args.subject
+    key = BasicAuthenticationAuthKey.parse(args.auth) if args.auth \
+        else BasicAuthenticationAuthKey.generate()
+    records = {r.subject.asString: r for r in await store.subjects()}
+    record = records.get(subject.asString)
+    ns = Namespace(EntityName(ns_name), key.uuid)
+    if record is None:
+        record = WhiskAuthRecord(subject, [ns], [key])
+    else:
+        if any(str(n.name) == ns_name for n in record.namespaces):
+            print("namespace already exists for subject", file=sys.stderr)
+            return 1
+        record.namespaces.append(ns)
+        record.keys.append(key)
+    await store.put(record)
+    print(key.compact)
+    return 0
+
+
+async def _user_get(store: AuthStore, args) -> int:
+    for record in await store.subjects():
+        if record.subject.asString == args.subject or args.subject in \
+                [str(n.name) for n in record.namespaces]:
+            if args.all:
+                print(json.dumps(record.to_json(), indent=2))
+            else:
+                for ns, key in zip(record.namespaces, record.keys):
+                    print(f"{key.compact}  # namespace {ns.name}")
+            return 0
+    print("subject missing", file=sys.stderr)
+    return 1
+
+
+async def _user_delete(store: AuthStore, args) -> int:
+    for record in await store.subjects():
+        if record.subject.asString == args.subject:
+            if args.namespace:
+                keep = [(n, k) for n, k in zip(record.namespaces, record.keys)
+                        if str(n.name) != args.namespace]
+                record.namespaces = [n for n, _ in keep]
+                record.keys = [k for _, k in keep]
+                await store.put(record)
+            else:
+                await store.store.delete(f"subject/{record.subject}")
+                store.cache.clear()
+            print("ok")
+            return 0
+    print("subject missing", file=sys.stderr)
+    return 1
+
+
+async def _user_list(store: AuthStore, args) -> int:
+    for record in await store.subjects():
+        flags = " (blocked)" if record.blocked else ""
+        nss = ",".join(str(n.name) for n in record.namespaces)
+        print(f"{record.subject}{flags}  namespaces: {nss}")
+    return 0
+
+
+async def _user_block(store: AuthStore, args, blocked: bool) -> int:
+    for record in await store.subjects():
+        if record.subject.asString == args.subject:
+            record.blocked = blocked
+            await store.put(record)
+            store.cache.clear()
+            print("ok")
+            return 0
+    print("subject missing", file=sys.stderr)
+    return 1
+
+
+async def _limits_set(store: AuthStore, args) -> int:
+    for record in await store.subjects():
+        if any(str(n.name) == args.namespace for n in record.namespaces):
+            record.limits[args.namespace] = UserLimits(
+                invocations_per_minute=args.invocations_per_minute,
+                concurrent_invocations=args.concurrent_invocations,
+                fires_per_minute=args.fires_per_minute)
+            await store.put(record)
+            store.cache.clear()
+            print("ok")
+            return 0
+    print("namespace missing", file=sys.stderr)
+    return 1
+
+
+async def _limits_get(store: AuthStore, args) -> int:
+    for record in await store.subjects():
+        if any(str(n.name) == args.namespace for n in record.namespaces):
+            limits = record.limits.get(args.namespace)
+            print(json.dumps(limits.to_json() if limits else {}))
+            return 0
+    print("namespace missing", file=sys.stderr)
+    return 1
+
+
+async def _db_get(raw_store, args) -> int:
+    docs = await raw_store.query(args.collection, args.namespace or None,
+                                 limit=args.limit)
+    for d in docs:
+        print(json.dumps(d))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="wskadmin",
+                                     description="OpenWhisk-TPU administration")
+    parser.add_argument("--db", required=True, help="sqlite store path")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    user = sub.add_parser("user").add_subparsers(dest="user_cmd", required=True)
+    c = user.add_parser("create")
+    c.add_argument("subject")
+    c.add_argument("--namespace", default=None)
+    c.add_argument("--auth", default=None, help="uuid:key to use")
+    g = user.add_parser("get")
+    g.add_argument("subject")
+    g.add_argument("--all", action="store_true")
+    d = user.add_parser("delete")
+    d.add_argument("subject")
+    d.add_argument("--namespace", default=None)
+    user.add_parser("list")
+    b = user.add_parser("block")
+    b.add_argument("subject")
+    u = user.add_parser("unblock")
+    u.add_argument("subject")
+
+    limits = sub.add_parser("limits").add_subparsers(dest="limits_cmd", required=True)
+    ls = limits.add_parser("set")
+    ls.add_argument("namespace")
+    ls.add_argument("--invocations-per-minute", type=int, default=None)
+    ls.add_argument("--concurrent-invocations", type=int, default=None)
+    ls.add_argument("--fires-per-minute", type=int, default=None)
+    lg = limits.add_parser("get")
+    lg.add_argument("namespace")
+
+    db = sub.add_parser("db").add_subparsers(dest="db_cmd", required=True)
+    dg = db.add_parser("get")
+    dg.add_argument("collection")
+    dg.add_argument("--namespace", default=None)
+    dg.add_argument("--limit", type=int, default=100)
+
+    args = parser.parse_args(argv)
+    raw = SqliteArtifactStore(args.db)
+    auth = AuthStore(raw)
+
+    async def run():
+        if args.cmd == "user":
+            return await {
+                "create": _user_create, "get": _user_get, "delete": _user_delete,
+                "list": _user_list,
+                "block": lambda s, a: _user_block(s, a, True),
+                "unblock": lambda s, a: _user_block(s, a, False),
+            }[args.user_cmd](auth, args)
+        if args.cmd == "limits":
+            return await {"set": _limits_set, "get": _limits_get}[args.limits_cmd](auth, args)
+        if args.cmd == "db":
+            return await _db_get(raw, args)
+        return 2
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

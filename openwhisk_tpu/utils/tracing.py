@@ -1,0 +1,134 @@
+"""Distributed tracing: spans correlated by transaction id.
+
+Rebuild of common/scala/.../common/tracing/OpenTracingProvider.scala:43-160 —
+a per-transid stack of spans; the active span's context serializes into
+`ActivationMessage.trace_context` (W3C traceparent style) and is restored on
+the invoker side, so traces survive the bus hop (Message.scala:61,
+InvokerReactive.scala:224). Finished spans go to a pluggable reporter
+(default: in-memory buffer; an OTLP/Zipkin exporter plugs in behind
+`Reporter`). Span caches expire so abandoned transactions don't leak.
+"""
+from __future__ import annotations
+
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start: float
+    end: Optional[float] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end or time.time()) - self.start) * 1e3
+
+    def to_json(self) -> dict:
+        return {"traceId": self.trace_id, "id": self.span_id,
+                "parentId": self.parent_id, "name": self.name,
+                "timestamp": int(self.start * 1e6),
+                "duration": int(self.duration_ms * 1e3), "tags": self.tags}
+
+
+class Reporter:
+    def report(self, span: Span) -> None:
+        raise NotImplementedError
+
+
+class BufferReporter(Reporter):
+    def __init__(self, max_spans: int = 10_000):
+        self.spans: List[Span] = []
+        self.max_spans = max_spans
+
+    def report(self, span: Span) -> None:
+        if len(self.spans) < self.max_spans:
+            self.spans.append(span)
+
+
+class Tracer:
+    """Span lifecycle keyed by transid (ref OpenTracer)."""
+
+    def __init__(self, reporter: Optional[Reporter] = None,
+                 expiry_seconds: float = 3600.0):
+        self.reporter = reporter or BufferReporter()
+        self.expiry = expiry_seconds
+        self._stacks: Dict[str, List[Span]] = {}
+        self._touched: Dict[str, float] = {}
+
+    def start_span(self, name: str, transid) -> Span:
+        stack = self._stacks.setdefault(transid.id, [])
+        parent = stack[-1] if stack else None
+        span = Span(
+            trace_id=parent.trace_id if parent else secrets.token_hex(16),
+            span_id=secrets.token_hex(8),
+            parent_id=parent.span_id if parent else None,
+            name=name, start=time.time())
+        stack.append(span)
+        self._touched[transid.id] = time.monotonic()
+        self._expire()
+        return span
+
+    def finish_span(self, transid, tags: Optional[Dict[str, str]] = None) -> Optional[Span]:
+        stack = self._stacks.get(transid.id)
+        if not stack:
+            return None
+        span = stack.pop()
+        span.end = time.time()
+        if tags:
+            span.tags.update(tags)
+        if not stack:
+            self._stacks.pop(transid.id, None)
+            self._touched.pop(transid.id, None)
+        self.reporter.report(span)
+        return span
+
+    def error(self, transid, message: str) -> None:
+        stack = self._stacks.get(transid.id)
+        if stack:
+            stack[-1].tags["error"] = message
+
+    # -- context propagation (traceparent style) ---------------------------
+    def get_trace_context(self, transid) -> Optional[Dict[str, str]]:
+        stack = self._stacks.get(transid.id)
+        if not stack:
+            return None
+        s = stack[-1]
+        return {"traceparent": f"00-{s.trace_id}-{s.span_id}-01"}
+
+    def set_trace_context(self, transid, context: Optional[Dict[str, str]]) -> None:
+        """Restore a remote parent so child spans link across the bus."""
+        if not context:
+            return
+        tp = context.get("traceparent", "")
+        parts = tp.split("-")
+        if len(parts) != 4:
+            return
+        remote = Span(trace_id=parts[1], span_id=parts[2], parent_id=None,
+                      name="remote-parent", start=time.time())
+        self._stacks.setdefault(transid.id, []).append(remote)
+        self._touched[transid.id] = time.monotonic()
+
+    def clear(self, transid) -> None:
+        """Drop any remaining spans for a transaction WITHOUT reporting them
+        (e.g. the invoker's restored remote parent after the work is done)."""
+        self._stacks.pop(transid.id, None)
+        self._touched.pop(transid.id, None)
+
+    def _expire(self) -> None:
+        if len(self._touched) < 1000:
+            return
+        cutoff = time.monotonic() - self.expiry
+        for tid in [t for t, at in self._touched.items() if at < cutoff]:
+            self._stacks.pop(tid, None)
+            self._touched.pop(tid, None)
+
+
+# process-wide default tracer (ref WhiskTracerProvider)
+GLOBAL_TRACER = Tracer()
