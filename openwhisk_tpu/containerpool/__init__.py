@@ -4,6 +4,11 @@ from .factory import ContainerFactory, ContainerPoolConfig
 from .process_factory import (ProcessContainer, ProcessContainerFactory,
                               ProcessContainerFactoryProvider)
 from .docker_factory import DockerContainerFactory, docker_available
+from .kubernetes_factory import (KubernetesClient, KubernetesClientConfig,
+                                 KubernetesContainer,
+                                 KubernetesContainerFactory, WhiskPodBuilder)
+from .yarn_factory import YARNConfig, YARNContainerFactory
+from .mesos_factory import MesosConfig, MesosContainerFactory
 from .pool import ContainerPool, Run
 from .proxy import ContainerProxy, ContainerData
 from .logstore import ContainerLogStore, ContainerLogStoreProvider

@@ -1,0 +1,320 @@
+"""Remote container drivers (Kubernetes / YARN / Mesos) against in-process
+fake API servers — the reference tests these with stubbed clients
+(KubernetesClientTests.scala, YARNContainerFactoryTests.scala,
+MesosContainerFactoryTest.scala); here the whole REST surface is exercised
+end-to-end against fakes."""
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from openwhisk_tpu.containerpool.container import ContainerError
+from openwhisk_tpu.containerpool.kubernetes_factory import (
+    KubernetesClientConfig, KubernetesContainerFactory, WhiskPodBuilder)
+from openwhisk_tpu.containerpool.mesos_factory import (MesosConfig,
+                                                       MesosContainerFactory)
+from openwhisk_tpu.containerpool.yarn_factory import (YARNConfig,
+                                                      YARNContainerFactory)
+from openwhisk_tpu.core.entity import MB
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def _serve(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, port
+
+
+# ---------------------------------------------------------------- kubernetes
+
+class FakeKubeAPI:
+    """Minimal pod lifecycle: pods become Running with an IP after one poll."""
+
+    def __init__(self):
+        self.pods = {}
+        self.deleted = []
+
+    def app(self):
+        app = web.Application()
+        app.router.add_post("/api/v1/namespaces/{ns}/pods", self.create)
+        app.router.add_get("/api/v1/namespaces/{ns}/pods", self.list_)
+        app.router.add_get("/api/v1/namespaces/{ns}/pods/{name}", self.get)
+        app.router.add_delete("/api/v1/namespaces/{ns}/pods/{name}", self.delete)
+        app.router.add_get("/api/v1/namespaces/{ns}/pods/{name}/log", self.log)
+        return app
+
+    async def create(self, req):
+        pod = await req.json()
+        name = pod["metadata"]["name"]
+        pod["status"] = {"phase": "Pending"}
+        self.pods[name] = pod
+        return web.json_response(pod, status=201)
+
+    async def get(self, req):
+        name = req.match_info["name"]
+        if name not in self.pods:
+            return web.json_response({}, status=404)
+        pod = self.pods[name]
+        # become ready on second look
+        if pod["status"]["phase"] == "Pending":
+            pod["status"] = {"phase": "Running", "podIP": "10.1.2.3"}
+        return web.json_response(pod)
+
+    async def list_(self, req):
+        sel = req.query.get("labelSelector", "")
+        k, _, v = sel.partition("=")
+        items = [p for p in self.pods.values()
+                 if p["metadata"].get("labels", {}).get(k) == v]
+        return web.json_response({"items": items})
+
+    async def delete(self, req):
+        name = req.match_info["name"]
+        self.deleted.append(name)
+        self.pods.pop(name, None)
+        return web.json_response({}, status=200)
+
+    async def log(self, req):
+        return web.Response(text="line1\nline2\n")
+
+
+class TestKubernetesDriver:
+    def test_pod_builder_manifest(self):
+        cfg = KubernetesClientConfig(cpu_scale_millis_per_mb=2.0,
+                                     user_pod_node_affinity={"pool": "actions"})
+        pod = WhiskPodBuilder(cfg, "invoker7").build(
+            "wsk-x", "whisk/nodejs:14", MB(256), "ns/act")
+        c = pod["spec"]["containers"][0]
+        assert c["resources"]["limits"]["memory"] == "256Mi"
+        assert c["resources"]["limits"]["cpu"] == "512m"
+        assert pod["metadata"]["labels"]["openwhisk/invoker"] == "invoker7"
+        assert pod["spec"]["nodeSelector"] == {"pool": "actions"}
+        assert pod["spec"]["restartPolicy"] == "Never"
+
+    def test_create_use_destroy_cleanup(self):
+        async def go():
+            fake = FakeKubeAPI()
+            runner, port = await _serve(fake.app())
+            try:
+                cfg = KubernetesClientConfig(
+                    api_server=f"http://127.0.0.1:{port}", timeout_s=5)
+                fac = KubernetesContainerFactory("invoker0", cfg)
+                cont = await fac.create_container(None, "job", "whisk/py:3",
+                                                  MB(128))
+                assert cont.addr == ("10.1.2.3", 8080)
+                logs = await cont.logs()
+                assert logs == ["line1", "line2"]
+                await cont.suspend()  # no-op must not raise
+                await cont.resume()
+                await cont.destroy()
+                assert cont.container_id in fake.deleted
+                # cleanup deletes any labelled leftovers
+                await fac.create_container(None, "leftover", "whisk/py:3", MB(128))
+                await fac.cleanup()
+                assert not fake.pods
+                await fac.close()
+            finally:
+                await runner.cleanup()
+        run(go())
+
+    def test_terminal_phase_raises_and_reaps(self):
+        async def go():
+            fake = FakeKubeAPI()
+
+            async def get_failed(req):
+                name = req.match_info["name"]
+                if name not in fake.pods:
+                    return web.json_response({}, status=404)
+                pod = fake.pods[name]
+                pod["status"] = {"phase": "Failed"}
+                return web.json_response(pod)
+
+            app = fake.app()
+            fake.get = get_failed  # route already bound; rebuild app
+            app2 = web.Application()
+            app2.router.add_post("/api/v1/namespaces/{ns}/pods", fake.create)
+            app2.router.add_get("/api/v1/namespaces/{ns}/pods/{name}", get_failed)
+            app2.router.add_delete("/api/v1/namespaces/{ns}/pods/{name}",
+                                   fake.delete)
+            runner, port = await _serve(app2)
+            try:
+                cfg = KubernetesClientConfig(
+                    api_server=f"http://127.0.0.1:{port}", timeout_s=2)
+                fac = KubernetesContainerFactory("invoker0", cfg)
+                with pytest.raises(ContainerError):
+                    await fac.create_container(None, "bad", "img", MB(128))
+                assert fake.deleted  # failed pod reaped
+                await fac.client.close()
+            finally:
+                await runner.cleanup()
+        run(go())
+
+
+# ---------------------------------------------------------------------- yarn
+
+class FakeYARNAPI:
+    """Services API: flex sets component counts; containers appear READY."""
+
+    def __init__(self):
+        self.services = {}
+        self.counter = 0
+
+    def app(self):
+        app = web.Application()
+        app.router.add_post("/app/v1/services", self.create)
+        app.router.add_get("/app/v1/services/{name}", self.describe)
+        app.router.add_put("/app/v1/services/{name}/components/{comp}",
+                           self.flex)
+        app.router.add_delete("/app/v1/services/{name}", self.delete)
+        return app
+
+    async def create(self, req):
+        svc = await req.json()
+        svc.setdefault("components", [])
+        self.services[svc["name"]] = svc
+        return web.json_response({}, status=202)
+
+    async def describe(self, req):
+        name = req.match_info["name"]
+        if name not in self.services:
+            return web.json_response({}, status=404)
+        return web.json_response(self.services[name])
+
+    async def flex(self, req):
+        name, comp = req.match_info["name"], req.match_info["comp"]
+        body = await req.json()
+        n = body["number_of_containers"]
+        svc = self.services[name]
+        comps = {c["name"]: c for c in svc["components"]}
+        entry = comps.setdefault(comp, {"name": comp, "containers": []})
+        if entry not in svc["components"]:
+            svc["components"].append(entry)
+        while len(entry["containers"]) < n:
+            self.counter += 1
+            entry["containers"].append({
+                "id": f"container_{self.counter}", "state": "READY",
+                "ip": f"10.2.0.{self.counter}"})
+        entry["containers"] = entry["containers"][:n]
+        return web.json_response({}, status=200)
+
+    async def delete(self, req):
+        self.services.pop(req.match_info["name"], None)
+        return web.json_response({}, status=204)
+
+
+class TestYARNDriver:
+    def test_flex_lifecycle(self):
+        async def go():
+            fake = FakeYARNAPI()
+            runner, port = await _serve(fake.app())
+            try:
+                cfg = YARNConfig(master_url=f"http://127.0.0.1:{port}")
+                fac = YARNContainerFactory("invoker1", cfg)
+                await fac.init()
+                assert fac.service in fake.services
+                c1 = await fac.create_container(None, "a", "whisk/nodejs:14",
+                                                MB(256))
+                c2 = await fac.create_container(None, "b", "whisk/nodejs:14",
+                                                MB(256))
+                assert c1.container_id != c2.container_id
+                assert c1.addr[0].startswith("10.2.0.")
+                # destroy flexes the component back down
+                await c1.destroy()
+                svc = fake.services[fac.service]
+                comp = svc["components"][0]
+                assert len(comp["containers"]) == 1
+                await fac.close()
+                assert fac.service not in fake.services
+            finally:
+                await runner.cleanup()
+        run(go())
+
+    def test_concurrent_creates_serialized_per_component(self):
+        async def go():
+            fake = FakeYARNAPI()
+            runner, port = await _serve(fake.app())
+            try:
+                cfg = YARNConfig(master_url=f"http://127.0.0.1:{port}")
+                fac = YARNContainerFactory("invoker2", cfg)
+                await fac.init()
+                conts = await asyncio.gather(*[
+                    fac.create_container(None, f"j{i}", "whisk/py:3", MB(128))
+                    for i in range(4)])
+                ids = {c.container_id for c in conts}
+                assert len(ids) == 4  # no double-claimed containers
+                await fac.close()
+            finally:
+                await runner.cleanup()
+        run(go())
+
+
+# --------------------------------------------------------------------- mesos
+
+class FakeMesosBridge:
+    def __init__(self):
+        self.tasks = {}
+        self.torn_down = False
+        self.port_counter = 31000
+
+    def app(self):
+        app = web.Application()
+        app.router.add_post("/tasks", self.submit)
+        app.router.add_get("/tasks", self.list_)
+        app.router.add_delete("/tasks/{tid}", self.kill)
+        app.router.add_post("/teardown", self.teardown)
+        return app
+
+    async def submit(self, req):
+        task = await req.json()
+        self.port_counter += 1
+        body = {"id": task["id"], "host": "agent-3.local",
+                "port": self.port_counter}
+        self.tasks[task["id"]] = body
+        return web.json_response(body, status=201)
+
+    async def list_(self, req):
+        prefix = req.query.get("prefix", "")
+        return web.json_response(
+            {"items": [t for t in self.tasks.values()
+                       if t["id"].startswith(prefix)]})
+
+    async def kill(self, req):
+        self.tasks.pop(req.match_info["tid"], None)
+        return web.json_response({}, status=200)
+
+    async def teardown(self, req):
+        self.torn_down = True
+        return web.json_response({})
+
+
+class TestMesosDriver:
+    def test_submit_kill_teardown(self):
+        async def go():
+            fake = FakeMesosBridge()
+            runner, port = await _serve(fake.app())
+            try:
+                cfg = MesosConfig(master_url=f"http://127.0.0.1:{port}",
+                                  teardown_on_exit=True)
+                fac = MesosContainerFactory("invoker0", cfg)
+                cont = await fac.create_container(None, "t", "whisk/java:8",
+                                                  MB(512))
+                assert cont.container_id.startswith("whisk-invoker0-")
+                assert cont.addr[0] == "agent-3.local"
+                assert cont.addr[1] > 31000
+                await cont.destroy()
+                assert cont.container_id not in fake.tasks
+                # leftovers reaped by cleanup — but only OUR invoker's tasks
+                await fac.create_container(None, "x", "whisk/java:8", MB(512))
+                other = {"id": "whisk-invoker9-alien", "host": "h", "port": 1}
+                fake.tasks[other["id"]] = other
+                await fac.close()
+                assert list(fake.tasks) == ["whisk-invoker9-alien"]
+                assert fake.torn_down
+            finally:
+                await runner.cleanup()
+        run(go())
