@@ -17,7 +17,6 @@ eviction instead.
 from __future__ import annotations
 
 import asyncio
-import json
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -86,7 +85,10 @@ class WhiskPodBuilder:
                 "labels": {
                     "name": name,
                     INVOKER_LABEL: self.invoker_name,
-                    ACTION_LABEL: action_name or "unknown",
+                    # label values allow [A-Za-z0-9._-] only, max 63 chars
+                    ACTION_LABEL: ("".join(
+                        c if (c.isalnum() or c in "._-") else "."
+                        for c in action_name)[:63] or "unknown"),
                 },
             },
             "spec": spec,
@@ -134,11 +136,11 @@ class KubernetesClient:
         async with self._http().post(self._url("/pods"), json=manifest,
                                      timeout=aiohttp.ClientTimeout(
                                          total=self.config.timeout_s)) as resp:
-            body = await resp.json(content_type=None)
             if resp.status not in (200, 201):
                 raise ContainerError(
-                    f"pod create failed ({resp.status}): {json.dumps(body)[:512]}")
-            return body
+                    f"pod create failed ({resp.status}): "
+                    f"{(await resp.text())[:512]}")
+            return await resp.json(content_type=None)
 
     async def get_pod(self, name: str) -> Dict[str, Any]:
         async with self._http().get(self._url(f"/pods/{name}")) as resp:
@@ -174,6 +176,10 @@ class KubernetesClient:
         async with self._http().get(
                 self._url("/pods"),
                 params={"labelSelector": label_selector}) as resp:
+            if resp.status != 200:
+                raise ContainerError(
+                    f"pod list failed ({resp.status}): "
+                    f"{(await resp.text())[:512]}")
             body = await resp.json(content_type=None)
             return body.get("items", [])
 
@@ -199,6 +205,7 @@ class KubernetesContainer(Container):
                  port: int = 8080):
         super().__init__(pod_name, (ip, port))
         self.client = client
+        self._log_offset = 0  # chars already attributed to past activations
 
     async def suspend(self) -> None:
         pass
@@ -212,8 +219,23 @@ class KubernetesContainer(Container):
 
     async def logs(self, limit_bytes: int = 10 * 1024 * 1024,
                    wait_for_sentinel: bool = True) -> List[str]:
+        """Only the lines this activation produced: the k8s log endpoint
+        always returns the full stream, so the driver tracks a per-container
+        offset (warm reuse) and strips the runtime's end-of-activation
+        sentinel lines, like the process/docker drivers."""
+        from .container import ACTIVATION_LOG_SENTINEL
         raw = await self.client.read_log(self.container_id)
-        return raw[-limit_bytes:].splitlines()
+        fresh = raw[self._log_offset:]
+        self._log_offset = len(raw)
+        lines = [l for l in fresh.splitlines()
+                 if ACTIVATION_LOG_SENTINEL not in l]
+        out, total = [], 0
+        for l in lines:
+            total += len(l.encode()) + 1
+            if total > limit_bytes:
+                break
+            out.append(l)
+        return out
 
 
 class KubernetesContainerFactory(ContainerFactory):
@@ -236,7 +258,8 @@ class KubernetesContainerFactory(ContainerFactory):
                                memory: ByteSize, cpu_shares: int = 0,
                                action=None) -> KubernetesContainer:
         pod_name = f"wsk-{name}-{uuid.uuid4().hex[:8]}".lower().replace("_", "-")
-        action_name = getattr(getattr(action, "fqn", None), "name", "") if action else ""
+        action_name = str(getattr(action, "fully_qualified_name", "") or "") \
+            if action else ""
         manifest = self.builder.build(pod_name, image, memory, str(action_name))
         await self.client.create_pod(manifest)
         try:
@@ -248,8 +271,12 @@ class KubernetesContainerFactory(ContainerFactory):
                                    port=self.config.action_port)
 
     async def cleanup(self) -> None:
-        for pod in await self.client.list_pods(
-                f"{INVOKER_LABEL}={self.invoker_name}"):
+        try:
+            pods = await self.client.list_pods(
+                f"{INVOKER_LABEL}={self.invoker_name}")
+        except (ContainerError, aiohttp.ClientError, OSError):
+            return  # janitorial only — an unreachable API must not abort close
+        for pod in pods:
             name = pod.get("metadata", {}).get("name")
             if name:
                 try:

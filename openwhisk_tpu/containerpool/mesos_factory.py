@@ -120,13 +120,14 @@ class MesosContainerFactory(ContainerFactory):
         self.config = config or MesosConfig()
         self.client = client or MesosBridgeClient(self.config)
         # task ids carry the invoker identity so cleanup/teardown of one
-        # invoker never reaps another invoker's live tasks on a shared bridge
-        self.task_prefix = f"whisk-{invoker_name}"
+        # invoker never reaps another invoker's live tasks on a shared
+        # bridge; trailing '-' so "invoker1" never prefix-matches "invoker10"
+        self.task_prefix = f"whisk-{invoker_name}-"
 
     async def create_container(self, transid, name: str, image: str,
                                memory: ByteSize, cpu_shares: int = 0,
                                action=None) -> MesosContainer:
-        task_id = f"{self.task_prefix}-{name}-{uuid.uuid4().hex[:8]}"
+        task_id = f"{self.task_prefix}{name}-{uuid.uuid4().hex[:8]}"
         body = await self.client.submit({
             "id": task_id,
             "image": image,

@@ -73,10 +73,36 @@ class YARNClient:
                 raise ContainerError(f"YARN describe failed ({resp.status})")
             return await resp.json(content_type=None)
 
-    async def flex(self, service: str, component: str, count: int) -> None:
+    async def add_component(self, service: str, component: str, image: str,
+                            cpus: int, memory_mb: int) -> None:
+        """Declare a component with its artifact + resource spec (the
+        reference pre-declares every runtime kind at service creation from
+        the ExecManifest; we declare lazily on first use)."""
+        async with self._http().put(
+                self._url(f"/{service}"),
+                json={"components": [{
+                    "name": component,
+                    "number_of_containers": 0,
+                    "artifact": {"id": image, "type": "DOCKER"},
+                    "resource": {"cpus": cpus, "memory": str(memory_mb)},
+                    "launch_command": "",
+                    "restart_policy": "NEVER",
+                }]}) as resp:
+            if resp.status not in (200, 202):
+                raise ContainerError(
+                    f"YARN add component {component} failed ({resp.status}): "
+                    f"{(await resp.text())[:512]}")
+            await resp.read()
+
+    async def flex(self, service: str, component: str, count: int,
+                   decommission: Optional[List[str]] = None) -> None:
+        body: Dict[str, Any] = {"number_of_containers": count}
+        if decommission:
+            # remove THESE instances, not an arbitrary newest one
+            body["decommissioned_instances"] = list(decommission)
         async with self._http().put(
                 self._url(f"/{service}/components/{component}"),
-                json={"number_of_containers": count}) as resp:
+                json=body) as resp:
             if resp.status not in (200, 202):
                 raise ContainerError(
                     f"YARN flex {component}={count} failed ({resp.status})")
@@ -146,10 +172,9 @@ class YARNContainerFactory(ContainerFactory):
                                 memory_mb: int) -> None:
         if component in self._components:
             return
-        # YARN adds components via flex-time definition on first use; the
-        # reference pre-declares every runtime kind at service creation. We
-        # declare lazily with an explicit component PUT.
-        await self.client.flex(self.service, component, 0)
+        await self.client.add_component(self.service, component, image,
+                                        self.config.cpus,
+                                        memory_mb or self.config.memory_fallback_mb)
         self._components[component] = 0
         self._known[component] = set()
 
@@ -157,13 +182,16 @@ class YARNContainerFactory(ContainerFactory):
                                memory: ByteSize, cpu_shares: int = 0,
                                action=None) -> YARNContainer:
         component = _component_name(image)
+        # serialize only the flex (count bump); the slow readiness poll runs
+        # unlocked so concurrent cold starts of one kind overlap, and each
+        # new container id is claimed under the lock so no two callers can
+        # adopt the same instance
         async with self._lock(component):
             await self._ensure_component(component, image, memory.to_mb)
             self._components[component] += 1
             await self.client.flex(self.service, component,
                                    self._components[component])
-            cont = await self._await_new_container(component)
-        return cont
+        return await self._await_new_container(component)
 
     async def _await_new_container(self, component: str) -> YARNContainer:
         deadline = asyncio.get_event_loop().time() + self._timeout_s
@@ -174,9 +202,11 @@ class YARNContainerFactory(ContainerFactory):
                     continue
                 for c in comp.get("containers", []):
                     cid = c.get("id")
-                    if (cid and cid not in self._known[component]
-                            and c.get("state") == "READY" and c.get("ip")):
-                        self._known[component].add(cid)
+                    if (cid and c.get("state") == "READY" and c.get("ip")):
+                        async with self._lock(component):
+                            if cid in self._known[component]:
+                                continue  # another caller claimed it
+                            self._known[component].add(cid)
                         return YARNContainer(self, component, cid, c["ip"],
                                              self.config.action_port)
             if asyncio.get_event_loop().time() > deadline:
@@ -190,8 +220,11 @@ class YARNContainerFactory(ContainerFactory):
         async with self._lock(component):
             self._known[component].discard(container.container_id)
             self._components[component] = max(0, self._components[component] - 1)
+            # decommission THIS instance: a bare flex-down lets YARN pick an
+            # arbitrary (possibly live, in-use) container to kill
             await self.client.flex(self.service, component,
-                                   self._components[component])
+                                   self._components[component],
+                                   decommission=[container.container_id])
 
     async def cleanup(self) -> None:
         try:

@@ -87,6 +87,16 @@ class ConductorInvoker:
             state = result.get("state")
             params = result.get("params", {k: v for k, v in result.items()
                                            if k not in ("action", "state", "params")})
+            # malformed conductor protocol fields are an APPLICATION error on
+            # the composition, never a crash (ref PrimitiveActions rejects
+            # non-object params/state with "invalid response")
+            if (not isinstance(params, dict)
+                    or (state is not None and not isinstance(state, dict))
+                    or (next_action is not None
+                        and not isinstance(next_action, str))):
+                response = ActivationResponse.application_error(
+                    "conductor returned an invalid response")
+                break
             if not next_action:
                 # composition finished: result is params (ref :300-316)
                 response = ActivationResponse.success(params)

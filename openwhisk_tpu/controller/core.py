@@ -65,7 +65,10 @@ class Controller:
                                           self.invoker, action_sequence_limit)
         self.trigger_service = TriggerService(self.entity_store,
                                               self.activation_store,
-                                              self.invoker, self.sequencer)
+                                              self.invoker, self.sequencer,
+                                              self.conductor)
+        # sequences route conductor components through the composition loop
+        self.sequencer.conductor = self.conductor
         self.web_actions = WebActionsApi(self)
         self.api = ControllerApi(self)
         self._runner: Optional[web.AppRunner] = None

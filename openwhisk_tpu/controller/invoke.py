@@ -71,7 +71,7 @@ class ActionInvoker:
         merged arguments."""
         transid = transid or TransactionId()
         from ..utils.tracing import GLOBAL_TRACER
-        GLOBAL_TRACER.start_span("controller_activation", transid)
+        span = GLOBAL_TRACER.start_span("controller_activation", transid)
         args = package_params.merge(action.parameters).merge(
             Parameters.from_arguments(payload or {}))
         msg = ActivationMessage(
@@ -96,7 +96,8 @@ class ActionInvoker:
         finally:
             GLOBAL_TRACER.finish_span(
                 transid, {"action": str(action.fully_qualified_name),
-                          "activationId": msg.activation_id.asString})
+                          "activationId": msg.activation_id.asString},
+                span=span)
 
     async def _wait_for_response(self, identity: Identity, msg: ActivationMessage,
                                  promise: asyncio.Future, wait: float

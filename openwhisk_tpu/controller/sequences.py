@@ -27,12 +27,14 @@ class TooManyActionsInSequence(Exception):
 
 class SequenceInvoker:
     def __init__(self, entity_store, activation_store, action_invoker: ActionInvoker,
-                 controller_instance, sequence_limit: int = 50):
+                 controller_instance, sequence_limit: int = 50,
+                 conductor=None):
         self.entity_store = entity_store
         self.activation_store = activation_store
         self.invoker = action_invoker
         self.controller = controller_instance
         self.sequence_limit = sequence_limit
+        self.conductor = conductor  # ConductorInvoker, wired by the controller
 
     async def invoke_sequence(self, identity: Identity, action: WhiskAction,
                               payload: Optional[Dict[str, Any]], blocking: bool,
@@ -68,11 +70,19 @@ class SequenceInvoker:
                 response = ActivationResponse.whisk_error(
                     f"Sequence component '{resolved}' does not exist.")
                 break
+            from .conductors import is_conductor
             if comp_action.is_sequence:
                 outcome = await self.invoke_sequence(
                     identity, comp_action, current, blocking=True,
                     transid=transid, cause=seq_aid,
                     components_budget=budget)  # shared: nested use counts
+            elif self.conductor is not None and is_conductor(comp_action):
+                # conductor components drive the composition loop, sharing
+                # this sequence's budget so nesting stays bounded
+                outcome = await self.conductor.invoke_composition(
+                    identity, comp_action, current, blocking=True,
+                    transid=transid, cause=seq_aid,
+                    package_params=pkg_params, budget=budget)
             else:
                 outcome = await self.invoker.invoke(
                     identity, comp_action, pkg_params, current, blocking=True,

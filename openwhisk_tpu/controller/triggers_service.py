@@ -15,16 +15,18 @@ from ..core.entity import (ACTIVE, ActivationId, ActivationResponse, Identity,
                            Parameters, WhiskActivation, WhiskTrigger)
 from ..database import NoDocumentException
 from ..utils.transaction import TransactionId
+from .conductors import is_conductor
 from .invoke import resolve_action
 
 
 class TriggerService:
     def __init__(self, entity_store, activation_store, action_invoker,
-                 sequencer=None):
+                 sequencer=None, conductor=None):
         self.entity_store = entity_store
         self.activation_store = activation_store
         self.invoker = action_invoker
         self.sequencer = sequencer
+        self.conductor = conductor
 
     async def fire(self, identity: Identity, trigger: WhiskTrigger,
                    payload: Optional[Dict[str, Any]],
@@ -57,9 +59,6 @@ class TriggerService:
     async def _fire_rule(self, identity, rule_name, rule, args, cause, transid) -> str:
         import json
 
-        # each fired rule gets its own transaction id: the rules run
-        # concurrently and the tracer's span stack is per-transid
-        transid = TransactionId()
         try:
             action, pkg_params = await resolve_action(
                 self.entity_store, rule.action.resolve(str(identity.namespace.name)),
@@ -68,6 +67,10 @@ class TriggerService:
                 outcome = await self.sequencer.invoke_sequence(
                     identity, action, args, blocking=False, transid=transid,
                     cause=cause)
+            elif self.conductor is not None and is_conductor(action):
+                outcome = await self.conductor.invoke_composition(
+                    identity, action, args, blocking=False, transid=transid,
+                    cause=cause, package_params=pkg_params)
             else:
                 outcome = await self.invoker.invoke(
                     identity, action, pkg_params, args, blocking=False,

@@ -7,6 +7,7 @@ the controller's view of persistence (SURVEY §3.5).
 """
 from __future__ import annotations
 
+import uuid
 from typing import Callable, List, Optional, Type
 
 from ..core.entity import (Identity, WhiskAction, WhiskActivation, WhiskEntity,
@@ -41,21 +42,32 @@ class EntityStore:
     async def put(self, entity: WhiskEntity) -> DocRevision:
         doc = entity.to_document()
         attachment = None
+        attachment_name = None
         exec_json = doc.get("exec")
         if isinstance(exec_json, dict):
             code = exec_json.get("code")
             if isinstance(code, str) and len(code) > self.ATTACHMENT_THRESHOLD:
                 attachment = code.encode()
-                exec_json["code"] = {"attachmentName": "codefile",
+                # unique name per put (ref: per-revision "sha-..." names): a
+                # concurrent loser's attachment write must never be paired
+                # with the winner's document stub. Orphans are reaped by
+                # delete_attachments on entity delete.
+                attachment_name = f"codefile-{uuid.uuid4().hex[:12]}"
+                exec_json["code"] = {"attachmentName": attachment_name,
                                      "attachmentType": "text/plain"}
         # attachment FIRST: a reader (or crash) between the two writes must
         # never see a stub document whose attachment does not exist yet
         if attachment is not None:
-            await self.store.attach(entity.docid, "codefile", "text/plain",
-                                    attachment)
+            await self.store.attach(entity.docid, attachment_name,
+                                    "text/plain", attachment)
         rev = await self.store.put(entity.docid, doc,
                                    entity.rev.rev if not entity.rev.empty else None)
         entity.rev = DocRevision(rev)
+        if attachment is not None:
+            # GC superseded per-put attachments now that this put WON the
+            # revision race (losers must never delete the winner's bytes)
+            await self.store.delete_attachments(entity.docid,
+                                                except_name=attachment_name)
         self.cache.update(entity.docid, entity)
         await self._notify(entity.docid)
         return entity.rev

@@ -83,8 +83,14 @@ class MemoryArtifactStore(ArtifactStore):
         except KeyError:
             raise NoDocumentException(f"attachment {doc_id}/{name}") from None
 
-    async def delete_attachments(self, doc_id: str) -> None:
-        self._attachments.pop(doc_id, None)
+    async def delete_attachments(self, doc_id: str,
+                                 except_name: Optional[str] = None) -> None:
+        if except_name is None:
+            self._attachments.pop(doc_id, None)
+        elif doc_id in self._attachments:
+            self._attachments[doc_id] = {
+                k: v for k, v in self._attachments[doc_id].items()
+                if k == except_name}
 
 
 class MemoryArtifactStoreProvider:

@@ -215,10 +215,17 @@ class SqliteArtifactStore(ArtifactStore):
             return row[0], bytes(row[1])
         return await self._run(go)
 
-    async def delete_attachments(self, doc_id: str) -> None:
+    async def delete_attachments(self, doc_id: str,
+                                 except_name: Optional[str] = None) -> None:
         def go():
             with self._conn() as conn:
-                conn.execute("DELETE FROM attachments WHERE doc_id=?", (doc_id,))
+                if except_name is None:
+                    conn.execute("DELETE FROM attachments WHERE doc_id=?",
+                                 (doc_id,))
+                else:
+                    conn.execute(
+                        "DELETE FROM attachments WHERE doc_id=? AND name<>?",
+                        (doc_id, except_name))
         await self._run(go)
 
     async def close(self) -> None:

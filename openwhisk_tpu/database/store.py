@@ -77,7 +77,10 @@ class ArtifactStore:
     async def read_attachment(self, doc_id: str, name: str) -> Tuple[str, bytes]:
         raise NotImplementedError
 
-    async def delete_attachments(self, doc_id: str) -> None:
+    async def delete_attachments(self, doc_id: str,
+                                 except_name: Optional[str] = None) -> None:
+        """Delete a document's attachments; `except_name` keeps the current
+        one (update-time GC of superseded per-put attachment names)."""
         raise NotImplementedError
 
     async def close(self) -> None:

@@ -58,6 +58,7 @@ class InvokerReactive:
         self._feed: Optional[MessageFeed] = None
         self._pinger: Optional[Scheduler] = None
         self._pending_release: dict = {}
+        self._active_spans: dict = {}
         from ..database import AuthStore
         from .blacklist import NamespaceBlacklist
         self.blacklist = NamespaceBlacklist(AuthStore(entity_store.store))
@@ -131,12 +132,15 @@ class InvokerReactive:
             release()
             return
         from ..utils.tracing import GLOBAL_TRACER
-        GLOBAL_TRACER.set_trace_context(msg.transid, msg.trace_context)
-        GLOBAL_TRACER.start_span("invoker_activation", msg.transid)
+        # stack-free span: concurrent activations may SHARE a transid (all
+        # rules of one trigger fire), so the span is keyed by activation id
+        # and parented straight from the message's trace context
+        span = GLOBAL_TRACER.start_remote_child("invoker_activation",
+                                                msg.trace_context)
         if self.blacklist.is_blacklisted(msg.user):
             await self._error_activation(
                 msg, "Namespace is disabled.")
-            GLOBAL_TRACER.clear(msg.transid)
+            GLOBAL_TRACER.finish(span, {"error": "namespace disabled"})
             release()
             return
         try:
@@ -147,16 +151,17 @@ class InvokerReactive:
             # feed capacity frees when the activation record is stored (the
             # proxy's last step) — registered by activation id
             self._pending_release[msg.activation_id.asString] = release
+            self._active_spans[msg.activation_id.asString] = span
             self.pool.run(Run(executable, msg))
         except NoDocumentException:
             await self._error_activation(msg, "The requested resource does not exist.")
-            GLOBAL_TRACER.clear(msg.transid)
+            GLOBAL_TRACER.finish(span, {"error": "resource does not exist"})
             release()
         except Exception as e:  # noqa: BLE001 — invoker loop must survive
             if self.logger:
                 self.logger.error(msg.transid, f"activation failed: {e!r}", "InvokerReactive")
             await self._error_activation(msg, f"Invoker error: {e}")
-            GLOBAL_TRACER.clear(msg.transid)
+            GLOBAL_TRACER.finish(span, {"error": str(e)})
             release()
 
     # -- proxy wiring ------------------------------------------------------
@@ -204,12 +209,11 @@ class InvokerReactive:
             release = self._pending_release.pop(activation.activation_id.asString, None)
             if release is not None:
                 release()
-            # report the invoker span and drop the restored remote parent
-            # (unfinished stacks would otherwise accumulate per transid)
             from ..utils.tracing import GLOBAL_TRACER
-            GLOBAL_TRACER.finish_span(transid, {
-                "activationId": activation.activation_id.asString})
-            GLOBAL_TRACER.clear(transid)
+            span = self._active_spans.pop(activation.activation_id.asString, None)
+            if span is not None:
+                GLOBAL_TRACER.finish(span, {
+                    "activationId": activation.activation_id.asString})
 
     async def _store_activation(self, transid, activation, user) -> None:
         try:

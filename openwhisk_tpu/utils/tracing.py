@@ -75,11 +75,21 @@ class Tracer:
         self._expire()
         return span
 
-    def finish_span(self, transid, tags: Optional[Dict[str, str]] = None) -> Optional[Span]:
+    def finish_span(self, transid, tags: Optional[Dict[str, str]] = None,
+                    span: Optional[Span] = None) -> Optional[Span]:
+        """Finish `span` (or the top of the stack when omitted). Passing the
+        span start_span returned makes concurrent invokes sharing one transid
+        safe: each finishes its OWN span even when interleaving reordered the
+        stack."""
         stack = self._stacks.get(transid.id)
         if not stack:
             return None
-        span = stack.pop()
+        if span is not None:
+            if span not in stack:
+                return None
+            stack.remove(span)
+        else:
+            span = stack.pop()
         span.end = time.time()
         if tags:
             span.tags.update(tags)
@@ -88,6 +98,27 @@ class Tracer:
             self._touched.pop(transid.id, None)
         self.reporter.report(span)
         return span
+
+    # -- stack-free spans (invoker side) -----------------------------------
+    def start_remote_child(self, name: str,
+                           context: Optional[Dict[str, str]]) -> Span:
+        """A span parented directly from a serialized traceparent, touching
+        no per-transid stack — safe when many activations share one transid
+        (e.g. all rules of one trigger fire) and finish out of order."""
+        parts = (context or {}).get("traceparent", "").split("-")
+        if len(parts) == 4:
+            trace_id, parent_id = parts[1], parts[2]
+        else:
+            trace_id, parent_id = secrets.token_hex(16), None
+        return Span(trace_id=trace_id, span_id=secrets.token_hex(8),
+                    parent_id=parent_id, name=name, start=time.time())
+
+    def finish(self, span: Span, tags: Optional[Dict[str, str]] = None) -> None:
+        """Finish and report a stack-free span."""
+        span.end = time.time()
+        if tags:
+            span.tags.update(tags)
+        self.reporter.report(span)
 
     def error(self, transid, message: str) -> None:
         stack = self._stacks.get(transid.id)

@@ -121,7 +121,8 @@ class TestCodeAttachments:
             # raw doc must NOT inline the code
             doc = await raw.get("guest/big")
             assert isinstance(doc["exec"]["code"], dict)
-            ct, data = await raw.read_attachment("guest/big", "codefile")
+            ct, data = await raw.read_attachment(
+                "guest/big", doc["exec"]["code"]["attachmentName"])
             assert len(data) == len(big_code.encode())
             # fresh store (cold cache) inflates transparently
             es2 = EntityStore(raw)

@@ -168,6 +168,69 @@ def main(args):
         assert any(a["key"] == "conductor" and a["value"] is True
                    for a in body["annotations"])
 
+    def test_invalid_conductor_params_is_application_error(self):
+        """A conductor returning a non-object `params` must yield an
+        application error on the composition, not an HTTP 500."""
+        from tests.test_system_standalone import (AUTH, HDRS, run_system, BASE)
+        import aiohttp
+
+        BAD = "def main(args):\n    return {'action': '_/x', 'params': 'oops'}\n"
+
+        async def go(s: aiohttp.ClientSession):
+            async with s.put(f"{BASE}/namespaces/_/actions/badcond", headers=HDRS,
+                             json={"exec": {"kind": "python:3", "code": BAD},
+                                   "annotations": [{"key": "conductor",
+                                                    "value": True}]}) as r:
+                assert r.status == 200
+            async with s.post(f"{BASE}/namespaces/_/actions/badcond?blocking=true",
+                              headers=HDRS, json={}) as r:
+                return r.status, await r.json()
+
+        status, body = run_system(go)
+        assert status == 502  # application error, surfaced like any other
+        assert "invalid response" in str(body["response"]["result"])
+
+    def test_conductor_as_sequence_component(self):
+        """A sequence whose component is a conductor must drive the whole
+        composition, not hand the raw control dict to the next component."""
+        from tests.test_system_standalone import (AUTH, HDRS, run_system, BASE)
+        import aiohttp
+
+        CONDUCTOR = """
+def main(args):
+    state = args.get('$composer', {'step': 0})
+    if state.get('step', 0) >= 1:
+        return {'params': {'n': args.get('n', 0)}}
+    return {'action': '_/increment', 'params': {'n': args.get('n', 0)},
+            'state': {'step': 1}}
+"""
+        INC = "def main(args):\n    return {'n': args.get('n', 0) + 1}\n"
+        DOUBLE = "def main(args):\n    return {'n': args.get('n', 0) * 2}\n"
+
+        async def go(s: aiohttp.ClientSession):
+            for name, code, ann in (("increment", INC, []),
+                                    ("double", DOUBLE, []),
+                                    ("compose1", CONDUCTOR,
+                                     [{"key": "conductor", "value": True}])):
+                async with s.put(f"{BASE}/namespaces/_/actions/{name}",
+                                 headers=HDRS,
+                                 json={"exec": {"kind": "python:3", "code": code},
+                                       "annotations": ann}) as r:
+                    assert r.status == 200
+            async with s.put(f"{BASE}/namespaces/_/actions/seqc", headers=HDRS,
+                             json={"exec": {"kind": "sequence",
+                                            "components": ["/_/compose1",
+                                                           "/_/double"]}}) as r:
+                assert r.status == 200, await r.text()
+            async with s.post(f"{BASE}/namespaces/_/actions/seqc?blocking=true",
+                              headers=HDRS, json={"n": 3}) as r:
+                return r.status, await r.json()
+
+        status, body = run_system(go)
+        assert status == 200, body
+        # conductor: 3 -> increment -> 4; then double -> 8
+        assert body["response"]["result"] == {"n": 8}
+
 
 @pytest.mark.slow
 class TestMultiProcessDeployment:

@@ -168,6 +168,7 @@ class FakeYARNAPI:
         app = web.Application()
         app.router.add_post("/app/v1/services", self.create)
         app.router.add_get("/app/v1/services/{name}", self.describe)
+        app.router.add_put("/app/v1/services/{name}", self.add_component)
         app.router.add_put("/app/v1/services/{name}/components/{comp}",
                            self.flex)
         app.router.add_delete("/app/v1/services/{name}", self.delete)
@@ -185,15 +186,36 @@ class FakeYARNAPI:
             return web.json_response({}, status=404)
         return web.json_response(self.services[name])
 
+    async def add_component(self, req):
+        name = req.match_info["name"]
+        body = await req.json()
+        svc = self.services[name]
+        for c in body.get("components", []):
+            if not c.get("artifact", {}).get("id"):
+                return web.json_response(
+                    {"diagnostics": "component without artifact"}, status=400)
+            if not c.get("resource", {}).get("memory"):
+                return web.json_response(
+                    {"diagnostics": "component without resource"}, status=400)
+            c.setdefault("containers", [])
+            svc["components"].append(c)
+        return web.json_response({}, status=202)
+
     async def flex(self, req):
         name, comp = req.match_info["name"], req.match_info["comp"]
         body = await req.json()
         n = body["number_of_containers"]
         svc = self.services[name]
         comps = {c["name"]: c for c in svc["components"]}
-        entry = comps.setdefault(comp, {"name": comp, "containers": []})
-        if entry not in svc["components"]:
-            svc["components"].append(entry)
+        if comp not in comps:  # real YARN rejects flex of undeclared comps
+            return web.json_response(
+                {"diagnostics": f"component {comp} not found"}, status=404)
+        entry = comps[comp]
+        # decommission removes the NAMED instances (never an arbitrary one)
+        decom = set(body.get("decommissioned_instances", []))
+        if decom:
+            entry["containers"] = [c for c in entry["containers"]
+                                   if c["id"] not in decom]
         while len(entry["containers"]) < n:
             self.counter += 1
             entry["containers"].append({
@@ -223,11 +245,16 @@ class TestYARNDriver:
                                                 MB(256))
                 assert c1.container_id != c2.container_id
                 assert c1.addr[0].startswith("10.2.0.")
-                # destroy flexes the component back down
-                await c1.destroy()
                 svc = fake.services[fac.service]
                 comp = svc["components"][0]
-                assert len(comp["containers"]) == 1
+                # component declared WITH image + memory (real YARN rejects
+                # flexing an undeclared/spec-less component)
+                assert comp["artifact"] == {"id": "whisk/nodejs:14",
+                                            "type": "DOCKER"}
+                assert comp["resource"]["memory"] == "256"
+                # destroy decommissions THAT instance, never the other one
+                await c1.destroy()
+                assert [c["id"] for c in comp["containers"]] == [c2.container_id]
                 await fac.close()
                 assert fac.service not in fake.services
             finally:

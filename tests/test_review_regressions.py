@@ -67,3 +67,54 @@ def test_invalidation_feed_capacity_not_inflated_by_bad_payloads():
         await r.stop()
         return free
     assert run(go()) <= 128
+
+
+def test_tracer_concurrent_spans_same_transid_finish_their_own():
+    """finish_span(span=...) must close the given span even when a later
+    concurrent span sits above it on the per-transid stack."""
+    from openwhisk_tpu.utils.tracing import BufferReporter, Tracer
+
+    rep = BufferReporter()
+    tr = Tracer(reporter=rep)
+    tid = TransactionId()
+    a = tr.start_span("invoke_a", tid)
+    b = tr.start_span("invoke_b", tid)  # interleaved concurrent invoke
+    tr.finish_span(tid, {"action": "a"}, span=a)  # a finishes FIRST
+    tr.finish_span(tid, {"action": "b"}, span=b)
+    by_name = {s.name: s for s in rep.spans}
+    assert by_name["invoke_a"].tags["action"] == "a"
+    assert by_name["invoke_b"].tags["action"] == "b"
+    assert not tr._stacks  # fully drained
+
+
+def test_attachment_conflict_loser_cannot_corrupt_winner_code():
+    """Concurrent action updates: the losing writer's attachment bytes must
+    never be paired with the winning writer's document (per-put names)."""
+    from openwhisk_tpu.core.entity import WhiskAction
+    from openwhisk_tpu.core.entity.exec import CodeExec
+    from openwhisk_tpu.core.entity.names import EntityName as EN
+    from openwhisk_tpu.database import MemoryArtifactStore
+    from openwhisk_tpu.database.entities import EntityStore
+    from openwhisk_tpu.database.store import DocumentConflict
+
+    big_a = "def main(x): return {'who': 'A'}\n" + "#" * 70_000
+    big_b = "def main(x): return {'who': 'B'}\n" + "#" * 70_000
+
+    async def go():
+        es = EntityStore(MemoryArtifactStore(), cache=None)
+        mk = lambda code: WhiskAction(EntityPath("ns"), EN("act"),
+                                      CodeExec(kind="python:3", code=code))
+        first = mk(big_a)
+        await es.put(first)                       # rev 1, code A
+        winner = mk(big_a.replace("'A'", "'A2'"))
+        winner.rev = first.rev
+        loser = mk(big_b)
+        loser.rev = first.rev
+        await es.put(winner)                      # rev 2, code A2
+        with pytest.raises(DocumentConflict):
+            await es.put(loser)                   # stale rev: must lose
+        got = await es.get(WhiskAction, "ns/act", use_cache=False)
+        return got.exec.code
+
+    code = run(go())
+    assert "'A2'" in code and "'B'" not in code  # winner doc ↔ winner code
