@@ -30,6 +30,18 @@ from .factory import ContainerFactory
 INVOKER_LABEL = "openwhisk/invoker"
 ACTION_LABEL = "openwhisk/action"
 
+_LABEL_OK = set("abcdefghijklmnopqrstuvwxyz"
+                "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789")
+
+
+def _label_value(name: str) -> str:
+    """Sanitize to a valid k8s label value: ASCII [A-Za-z0-9._-], ≤63 chars,
+    starts and ends alphanumeric."""
+    cleaned = "".join(c if (c in _LABEL_OK or c in "._-") else "."
+                      for c in name)[:63]
+    cleaned = cleaned.strip("._-")
+    return cleaned or "unknown"
+
 
 @dataclass
 class KubernetesClientConfig:
@@ -85,10 +97,9 @@ class WhiskPodBuilder:
                 "labels": {
                     "name": name,
                     INVOKER_LABEL: self.invoker_name,
-                    # label values allow [A-Za-z0-9._-] only, max 63 chars
-                    ACTION_LABEL: ("".join(
-                        c if (c.isalnum() or c in "._-") else "."
-                        for c in action_name)[:63] or "unknown"),
+                    # label values allow [A-Za-z0-9._-] only, max 63 chars,
+                    # and must start/end alphanumeric (ASCII)
+                    ACTION_LABEL: _label_value(action_name),
                 },
             },
             "spec": spec,
@@ -218,17 +229,37 @@ class KubernetesContainer(Container):
         await self.client.delete_pod(self.container_id)
 
     async def logs(self, limit_bytes: int = 10 * 1024 * 1024,
-                   wait_for_sentinel: bool = True) -> List[str]:
+                   wait_for_sentinel: bool = True,
+                   sentinel_timeout: float = 2.0) -> List[str]:
         """Only the lines this activation produced: the k8s log endpoint
         always returns the full stream, so the driver tracks a per-container
-        offset (warm reuse) and strips the runtime's end-of-activation
-        sentinel lines, like the process/docker drivers."""
+        offset (warm reuse). Polls until the runtime's end-of-activation
+        sentinel shows up past the offset (the runtime may not have flushed
+        yet when /run returns), then advances the offset past it so a late
+        tail is never misattributed to the next activation — same contract
+        as the process/docker drivers."""
+        import asyncio
+
         from .container import ACTIVATION_LOG_SENTINEL
-        raw = await self.client.read_log(self.container_id)
-        fresh = raw[self._log_offset:]
-        self._log_offset = len(raw)
-        lines = [l for l in fresh.splitlines()
-                 if ACTIVATION_LOG_SENTINEL not in l]
+        deadline = asyncio.get_event_loop().time() + sentinel_timeout
+        while True:
+            raw = await self.client.read_log(self.container_id)
+            fresh = raw[self._log_offset:]
+            if ACTIVATION_LOG_SENTINEL in fresh or not wait_for_sentinel:
+                head, _, _ = fresh.partition(ACTIVATION_LOG_SENTINEL + "\n")
+                if ACTIVATION_LOG_SENTINEL in fresh:
+                    self._log_offset += len(head) + len(ACTIVATION_LOG_SENTINEL) + 1
+                else:
+                    self._log_offset += len(fresh)
+                    head = fresh
+                break
+            if asyncio.get_event_loop().time() > deadline:
+                head = fresh
+                self._log_offset += len(fresh)
+                break
+            await asyncio.sleep(0.05)
+        lines = [l for l in head.splitlines()
+                 if ACTIVATION_LOG_SENTINEL not in l and l]
         out, total = [], 0
         for l in lines:
             total += len(l.encode()) + 1

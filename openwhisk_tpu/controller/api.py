@@ -34,6 +34,7 @@ from .entitlement import (ACTIVATE, DELETE, EntitlementException, PUT, READ,
                           ThrottleRejectRequest)
 from .loadbalancer.base import LoadBalancerException
 from .invoke import resolve_action
+from .routemgmt import ApiManagementException
 
 MAX_LIST_LIMIT = 200
 
@@ -75,6 +76,9 @@ class ControllerApi:
         # packages
         r.add_get(base + "/packages", self.list_packages)
         r.add_route("*", base + "/packages/{name}", self.package_entry)
+        # api-gateway route management (reference: core/routemgmt JS actions,
+        # surfaced here as a first-class /apis collection)
+        r.add_route("*", base + "/apis", self.apis_entry)
         # web actions (anonymous)
         r.add_route("*", "/api/v1/web/{ns}/{pkg}/{name:.+}", self.web_action)
         # system
@@ -513,6 +517,40 @@ class ControllerApi:
                               request["transid"])
             await self.c.entity_store.delete(pkg)
             return web.json_response(pkg.to_json())
+        return _error(405, "method not allowed")
+
+    # ------------------------------------------------------- api gateway mgmt
+    async def apis_entry(self, request):
+        """Route-management surface (reference core/routemgmt createApi/
+        getApi/deleteApi actions): CRUD swagger-shaped API route docs served
+        by the edge proxy."""
+        ns = self._namespace(request)
+        rm = self.c.route_manager
+        if request.method == "GET":
+            await self._check(request, READ, ns)
+            apis = await rm.get_apis(ns, request.query.get("basepath"),
+                                     request.query.get("relpath"),
+                                     request.query.get("operation"))
+            return web.json_response({"apis": apis})
+        if request.method in ("PUT", "POST"):
+            await self._check(request, PUT, ns)
+            body = await request.json()
+            apidoc = body.get("apidoc", body)
+            try:
+                view = await rm.create_api(ns, apidoc)
+            except ApiManagementException as e:
+                return _error(e.status, e.message, request["transid"])
+            return web.json_response(view)
+        if request.method == "DELETE":
+            await self._check(request, DELETE, ns)
+            basepath = request.query.get("basepath")
+            if not basepath:
+                return _error(400, "basepath query parameter required",
+                              request["transid"])
+            await rm.delete_api(ns, basepath,
+                                request.query.get("relpath"),
+                                request.query.get("operation"))
+            return web.Response(status=204)
         return _error(405, "method not allowed")
 
     # ----------------------------------------------------------- web actions

@@ -21,6 +21,7 @@ from .api import ControllerApi
 from .authentication import BasicAuthenticationProvider
 from .entitlement import LocalEntitlementProvider
 from .invoke import ActionInvoker
+from .routemgmt import ApiRouteManager
 from .sequences import SequenceInvoker
 from .triggers_service import TriggerService
 from .web_actions import WebActionsApi
@@ -70,6 +71,7 @@ class Controller:
         # sequences route conductor components through the composition loop
         self.sequencer.conductor = self.conductor
         self.web_actions = WebActionsApi(self)
+        self.route_manager = ApiRouteManager(store)
         self.api = ControllerApi(self)
         self._runner: Optional[web.AppRunner] = None
         # resources an assembler (e.g. standalone) co-locates with this

@@ -73,7 +73,7 @@ class EntityStore:
         return entity.rev
 
     async def get(self, cls: Type, doc_id: str, use_cache: bool = True):
-        async def load():
+        async def load_once():
             doc = await self.store.get(doc_id)
             exec_json = doc.get("exec")
             if isinstance(exec_json, dict) and isinstance(exec_json.get("code"), dict):
@@ -83,6 +83,15 @@ class EntityStore:
             ent = cls.from_json(doc)
             ent.rev = DocRevision(doc.get("_rev"))
             return ent
+
+        async def load():
+            try:
+                return await load_once()
+            except NoDocumentException:
+                # a concurrent update may have GC'd the attachment we read the
+                # stub for between our two reads — the fresh doc names the
+                # current attachment, so one retry settles it
+                return await load_once()
 
         if use_cache:
             return await self.cache.get_or_load(doc_id, load)

@@ -1,0 +1,156 @@
+"""Asyncio edge reverse-proxy — the nginx role of the reference deployment.
+
+Behavior ported from ansible/roles/nginx/templates/nginx.conf.j2:
+  * upstream pool over all controllers with keepalive + failover: a
+    connect-failed upstream is skipped for `fail_timeout` seconds
+    (nginx `server ... fail_timeout=60s`);
+  * vanity URLs: a request whose Host is `{namespace}.{domain}` is rewritten
+    to `/api/v1/web/{namespace}{path}` (root → `/public/index.html`);
+  * `/metrics` is denied from the edge (`location /metrics { deny all; }`);
+  * a per-request transaction id header is injected and echoed
+    (`proxy_set_header X-Request-ID`);
+  * optional TLS termination via an `ssl.SSLContext`.
+
+On top of that it serves API-gateway routes (reference: external gateway +
+core/routemgmt): requests matching a registered (basePath, relPath, verb)
+are forwarded to the backing web action.
+"""
+from __future__ import annotations
+
+import asyncio
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+TRANSACTION_HEADER = "X-Request-ID"
+MAX_BODY = 50 * 1024 * 1024  # nginx client_max_body_size 50M
+HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "upgrade",
+               "proxy-authenticate", "proxy-authorization", "te", "trailers",
+               "host", "content-length"}
+
+
+@dataclass
+class Upstream:
+    url: str  # e.g. http://127.0.0.1:3233
+    fail_until: float = 0.0
+    fails: int = 0
+
+    def usable(self) -> bool:
+        return time.monotonic() >= self.fail_until
+
+
+@dataclass
+class EdgeProxy:
+    upstreams: List[Upstream]
+    domain: str = ""  # vanity base domain; "" disables subdomain rewrite
+    fail_timeout: float = 60.0
+    read_timeout: float = 75.0  # nginx proxy_read_timeout 75s
+    route_matcher: Optional[Callable[[str, str], Awaitable[Optional[Dict]]]] = None
+    _rr: int = 0
+    _session: Optional[aiohttp.ClientSession] = None
+    _runner: Optional[web.AppRunner] = None
+    extra_denied_paths: tuple = ("/metrics",)
+
+    @classmethod
+    def for_controllers(cls, urls: List[str], **kwargs) -> "EdgeProxy":
+        return cls(upstreams=[Upstream(u.rstrip("/")) for u in urls], **kwargs)
+
+    # --------------------------------------------------------------- server
+    def make_app(self) -> web.Application:
+        app = web.Application(client_max_size=MAX_BODY)
+        app.router.add_route("*", "/{tail:.*}", self.handle)
+        return app
+
+    async def start(self, host: str = "0.0.0.0", port: int = 8080,
+                    ssl_context=None) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.read_timeout))
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        await web.TCPSite(self._runner, host, port,
+                          ssl_context=ssl_context).start()
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+        if self._session:
+            await self._session.close()
+
+    # -------------------------------------------------------------- routing
+    def _vanity_namespace(self, request: web.Request) -> Optional[str]:
+        if not self.domain:
+            return None
+        host = request.host.split(":")[0]
+        suffix = "." + self.domain
+        if host.endswith(suffix):
+            ns = host[: -len(suffix)]
+            if ns and all(c.isalnum() or c == "-" for c in ns):
+                return ns
+        return None
+
+    async def _rewrite(self, request: web.Request) -> str:
+        """Return the upstream path for this request; raise to deny/404."""
+        path = request.path
+        if path in self.extra_denied_paths:
+            raise web.HTTPForbidden(text="forbidden")
+        if path.startswith("/api/"):
+            return path
+        ns = self._vanity_namespace(request)
+        if ns is not None:
+            target = "/public/index.html" if path == "/" else path
+            return f"/api/v1/web/{ns}{target}"
+        if self.route_matcher is not None:
+            op = await self.route_matcher(request.method, path)
+            if op is not None:
+                url = op.get("url", "")
+                # strip any host prefix the route doc may carry
+                if "://" in url:
+                    url = "/" + url.split("://", 1)[1].split("/", 1)[1]
+                return url
+        # no API path, no vanity host, no gateway route: nothing to serve
+        raise web.HTTPNotFound(text="no route")
+
+    # ---------------------------------------------------------------- proxy
+    async def handle(self, request: web.Request) -> web.Response:
+        target = await self._rewrite(request)
+        transid = request.headers.get(TRANSACTION_HEADER) or secrets.token_hex(8)
+        body = await request.read() if request.can_read_body else None
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in HOP_HEADERS}
+        headers[TRANSACTION_HEADER] = transid
+
+        qs = request.query_string
+        suffix = target + (("?" + qs) if qs else "")
+        last_error: Optional[Exception] = None
+        for upstream in self._pick_order():
+            try:
+                async with self._session.request(
+                        request.method, upstream.url + suffix,
+                        headers=headers, data=body,
+                        allow_redirects=False) as resp:
+                    payload = await resp.read()
+                    upstream.fails = 0
+                    out_headers = {k: v for k, v in resp.headers.items()
+                                   if k.lower() not in HOP_HEADERS
+                                   and k.lower() != "content-encoding"}
+                    out_headers[TRANSACTION_HEADER] = transid
+                    return web.Response(status=resp.status, body=payload,
+                                        headers=out_headers)
+            except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
+                upstream.fails += 1
+                upstream.fail_until = time.monotonic() + self.fail_timeout
+                last_error = e
+        return web.Response(status=502, text=f"no upstream available: {last_error}")
+
+    def _pick_order(self) -> List[Upstream]:
+        """Round-robin over usable upstreams; all down → try everyone anyway
+        (nginx resurrects a dead pool rather than hard-failing)."""
+        n = len(self.upstreams)
+        order = [self.upstreams[(self._rr + i) % n] for i in range(n)]
+        self._rr = (self._rr + 1) % n
+        usable = [u for u in order if u.usable()]
+        return usable or order

@@ -1,0 +1,219 @@
+"""API-gateway route management + edge proxy tests.
+
+Covers the rebuild of core/routemgmt (createApi/getApi/deleteApi JS actions)
+and the nginx edge role (ansible/roles/nginx/templates/nginx.conf.j2):
+upstream failover, vanity-namespace rewrite, gateway route dispatch, and
+/metrics denial.
+"""
+import asyncio
+import base64
+
+import aiohttp
+import pytest
+
+from openwhisk_tpu.controller.routemgmt import (ApiManagementException,
+                                                ApiRouteManager)
+from openwhisk_tpu.database.memory_store import MemoryArtifactStore
+from openwhisk_tpu.edge import EdgeProxy, Upstream
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID, make_standalone
+
+AUTH = "Basic " + base64.b64encode(f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+
+C_PORT = 13321
+E_PORT = 13322
+CBASE = f"http://127.0.0.1:{C_PORT}"
+EBASE = f"http://127.0.0.1:{E_PORT}"
+
+WEB_CODE = """
+def main(args):
+    return {'greeting': 'Hello ' + args.get('who', 'world') + '!'}
+"""
+
+
+def _apidoc(base="/hello", rel="/greet", verb="get", action="webhello",
+            **extra):
+    doc = {"gatewayBasePath": base, "gatewayPath": rel, "gatewayMethod": verb,
+           "action": {"name": action, "namespace": "guest"},
+           "responsetype": "json"}
+    doc.update(extra)
+    return doc
+
+
+class TestApiRouteManager:
+    def run(self, coro):
+        return asyncio.run(coro)
+
+    def test_create_get_delete_cycle(self):
+        async def go():
+            rm = ApiRouteManager(MemoryArtifactStore())
+            view = await rm.create_api("guest", _apidoc())
+            assert view["basePath"] == "/hello"
+            assert "/greet" in view["swagger"]["paths"]
+            # second verb on the same path merges into the same doc
+            await rm.create_api("guest", _apidoc(verb="post"))
+            # another relPath
+            await rm.create_api("guest", _apidoc(rel="/bye", apiName="hello-api"))
+            apis = await rm.get_apis("guest")
+            assert len(apis) == 1
+            paths = apis[0]["swagger"]["paths"]
+            assert set(paths["/greet"]) == {"get", "post"}
+
+            # filtered get: one path, one verb
+            only = await rm.get_apis("guest", base_path="/hello",
+                                     rel_path="/greet", verb="post")
+            assert set(only[0]["swagger"]["paths"]) == {"/greet"}
+            assert set(only[0]["swagger"]["paths"]["/greet"]) == {"post"}
+            # filter by apiName works too (getApi.js matches name or path)
+            byname = await rm.get_apis("guest", base_path="hello-api")
+            assert byname and byname[0]["basePath"] == "/hello"
+
+            # delete one verb; the other survives
+            await rm.delete_api("guest", "/hello", "/greet", "post")
+            apis = await rm.get_apis("guest")
+            assert set(apis[0]["swagger"]["paths"]["/greet"]) == {"get"}
+            # delete whole relPath
+            await rm.delete_api("guest", "/hello", "/bye")
+            assert "/bye" not in (await rm.get_apis("guest"))[0]["swagger"]["paths"]
+            # deleting the last path removes the doc entirely
+            await rm.delete_api("guest", "/hello", "/greet")
+            assert await rm.get_apis("guest") == []
+        self.run(go())
+
+    def test_validation_errors(self):
+        async def go():
+            rm = ApiRouteManager(MemoryArtifactStore())
+            with pytest.raises(ApiManagementException):
+                await rm.create_api("guest", {"gatewayBasePath": "/x"})
+            with pytest.raises(ApiManagementException):
+                await rm.create_api("guest", _apidoc(verb="teapot"))
+            with pytest.raises(ApiManagementException):
+                await rm.create_api("guest", _apidoc(responsetype="yaml"))
+        self.run(go())
+
+    def test_swagger_install_and_match(self):
+        async def go():
+            rm = ApiRouteManager(MemoryArtifactStore())
+            await rm.create_api("guest", _apidoc())
+            await rm.create_api("guest", _apidoc(base="/hello/deep", rel="/greet",
+                                               action="deep"))
+            # longest basePath prefix wins
+            op = await rm.match("GET", "/hello/deep/greet")
+            assert op["action"] == "deep"
+            op = await rm.match("GET", "/hello/greet")
+            assert op["action"] == "webhello"
+            assert await rm.match("POST", "/hello/greet") is None
+            assert await rm.match("GET", "/nothing") is None
+            # full swagger install (createApi.js swagger branch)
+            await rm.create_api("guest", {"swagger": {
+                "swagger": "2.0", "basePath": "/sw", "info": {"title": "sw"},
+                "paths": {"/p": {"get": {"x-openwhisk": {
+                    "namespace": "guest", "package": "", "action": "webhello",
+                    "responsetype": "json",
+                    "url": "/api/v1/web/guest/default/webhello.json"}}}}}})
+            op = await rm.match("GET", "/sw/p")
+            assert op["action"] == "webhello"
+        self.run(go())
+
+
+class TestEdgeProxySystem:
+    def run_edge(self, coro_fn, domain="", dead_upstream=False):
+        async def go():
+            controller = await make_standalone(port=C_PORT)
+            urls = ([f"http://127.0.0.1:{C_PORT - 9}"] if dead_upstream else []) \
+                + [CBASE]
+            edge = EdgeProxy.for_controllers(
+                urls, domain=domain,
+                route_matcher=controller.route_manager.match)
+            await edge.start(host="127.0.0.1", port=E_PORT)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    # create the web action behind everything
+                    async with s.put(
+                            f"{CBASE}/api/v1/namespaces/_/actions/webhello",
+                            headers=HDRS,
+                            json={"exec": {"kind": "python:3", "code": WEB_CODE},
+                                  "annotations": [{"key": "web-export",
+                                                   "value": True}]}) as r:
+                        assert r.status == 200
+                    return await coro_fn(s)
+            finally:
+                await edge.stop()
+                await controller.stop()
+        return asyncio.run(go())
+
+    def test_proxy_api_routes_and_deny_metrics(self):
+        async def go(s):
+            out = {}
+            async with s.get(f"{EBASE}/api/v1") as r:
+                out["info"] = r.status
+            async with s.get(f"{EBASE}/metrics") as r:
+                out["metrics"] = r.status
+            # authenticated CRUD through the edge
+            async with s.get(f"{EBASE}/api/v1/namespaces/_/actions",
+                             headers=HDRS) as r:
+                out["list"] = (r.status, [a["name"] for a in await r.json()])
+                out["transid"] = r.headers.get("X-Request-ID") is not None
+            return out
+        out = self.run_edge(go)
+        assert out["info"] == 200
+        assert out["metrics"] == 403
+        assert out["list"] == (200, ["webhello"])
+        assert out["transid"]
+
+    def test_gateway_route_dispatch(self):
+        async def go(s):
+            # register the API route on the controller
+            async with s.put(f"{CBASE}/api/v1/namespaces/_/apis",
+                             headers=HDRS, json={"apidoc": _apidoc()}) as r:
+                assert r.status == 200, await r.text()
+            out = {}
+            async with s.get(f"{EBASE}/hello/greet?who=Edge") as r:
+                out["hit"] = (r.status, await r.json())
+            async with s.get(f"{EBASE}/hello/nope") as r:
+                out["miss"] = r.status
+            # list through the REST surface
+            async with s.get(f"{CBASE}/api/v1/namespaces/_/apis",
+                             headers=HDRS) as r:
+                out["apis"] = [a["basePath"] for a in (await r.json())["apis"]]
+            # delete and verify the edge stops serving it
+            async with s.delete(
+                    f"{CBASE}/api/v1/namespaces/_/apis?basepath=/hello",
+                    headers=HDRS) as r:
+                out["del"] = r.status
+            async with s.get(f"{EBASE}/hello/greet") as r:
+                out["after_del"] = r.status
+            return out
+        out = self.run_edge(go)
+        assert out["hit"] == (200, {"greeting": "Hello Edge!"})
+        assert out["miss"] == 404
+        assert out["apis"] == ["/hello"]
+        assert out["del"] == 204
+        assert out["after_del"] == 404
+
+    def test_vanity_namespace_rewrite(self):
+        async def go(s):
+            # Host: guest.example.test → /api/v1/web/guest/... rewrite
+            hdrs = {"Host": "guest.example.test"}
+            out = {}
+            async with s.get(f"{EBASE}/default/webhello.json?who=Vanity",
+                             headers=hdrs) as r:
+                out["vanity"] = (r.status, await r.json())
+            # API paths pass through untouched even with a vanity host
+            async with s.get(f"{EBASE}/api/v1", headers=hdrs) as r:
+                out["api_untouched"] = r.status
+            return out
+        out = self.run_edge(go, domain="example.test")
+        assert out["vanity"] == (200, {"greeting": "Hello Vanity!"})
+        assert out["api_untouched"] == 200
+
+    def test_upstream_failover(self):
+        async def go(s):
+            # first upstream in the pool is dead; request must still succeed
+            out = {}
+            for _ in range(3):  # round-robin hits the dead one at least once
+                async with s.get(f"{EBASE}/api/v1") as r:
+                    out.setdefault("codes", []).append(r.status)
+            return out
+        out = self.run_edge(go, dead_upstream=True)
+        assert out["codes"] == [200, 200, 200]
