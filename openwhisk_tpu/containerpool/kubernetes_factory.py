@@ -241,23 +241,31 @@ class KubernetesContainer(Container):
         import asyncio
 
         from .container import ACTIVATION_LOG_SENTINEL
+        # the pod log endpoint merges stdout+stderr, and the runtime writes
+        # the sentinel to BOTH streams — a complete activation therefore ends
+        # with two complete sentinel lines in the merged stream
+        marker = ACTIVATION_LOG_SENTINEL + "\n"
         deadline = asyncio.get_event_loop().time() + sentinel_timeout
         while True:
             raw = await self.client.read_log(self.container_id)
             fresh = raw[self._log_offset:]
-            if ACTIVATION_LOG_SENTINEL in fresh or not wait_for_sentinel:
-                head, _, _ = fresh.partition(ACTIVATION_LOG_SENTINEL + "\n")
-                if ACTIVATION_LOG_SENTINEL in fresh:
-                    self._log_offset += len(head) + len(ACTIVATION_LOG_SENTINEL) + 1
-                else:
-                    self._log_offset += len(fresh)
-                    head = fresh
+            complete = fresh.count(marker)  # only fully-written sentinel lines
+            if complete >= 2 or not wait_for_sentinel:
                 break
             if asyncio.get_event_loop().time() > deadline:
-                head = fresh
-                self._log_offset += len(fresh)
                 break
             await asyncio.sleep(0.05)
+        if complete:
+            # consume through the LAST complete sentinel line; a partial
+            # sentinel still being written stays for the next call
+            end = 0
+            for _ in range(complete):
+                end = fresh.index(marker, end) + len(marker)
+            head = fresh[:end]
+            self._log_offset += end
+        else:
+            head = fresh
+            self._log_offset += len(fresh)
         lines = [l for l in head.splitlines()
                  if ACTIVATION_LOG_SENTINEL not in l and l]
         out, total = [], 0

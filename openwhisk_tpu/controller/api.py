@@ -335,7 +335,10 @@ class ControllerApi:
 
     async def get_activation_logs(self, request):
         a = await self._activation(request)
-        return web.json_response({"logs": a.logs})
+        # LogStore SPI fetch side (ref LogStore.fetchLogs): remote stores
+        # (Elastic/Splunk) pull from their backend; default reads the record
+        logs = await self.c.log_store.fetch_logs(request["identity"], a)
+        return web.json_response({"logs": logs})
 
     async def get_activation_result(self, request):
         a = await self._activation(request)

@@ -12,6 +12,7 @@ from typing import Optional
 from aiohttp import web
 
 from .. import spi
+from ..containerpool.logstore import ContainerLogStore
 from ..core.entity import (ACTIVE, ControllerInstanceId, INACTIVE, ReducedRule)
 from ..database import (ArtifactActivationStore, AuthStore, EntityStore,
                         MemoryArtifactStore, NoDocumentException,
@@ -34,7 +35,8 @@ class Controller:
                  action_sequence_limit: int = 50,
                  invocations_per_minute: int = 60,
                  concurrent_invocations: int = 30,
-                 fires_per_minute: int = 60):
+                 fires_per_minute: int = 60,
+                 log_store=None):
         self.instance = instance
         self.provider = messaging_provider
         self.logger = logger or Logging()
@@ -71,6 +73,8 @@ class Controller:
         # sequences route conductor components through the composition loop
         self.sequencer.conductor = self.conductor
         self.web_actions = WebActionsApi(self)
+        self.log_store = log_store if log_store is not None \
+            else ContainerLogStore()
         self.route_manager = ApiRouteManager(store)
         self.api = ControllerApi(self)
         self._runner: Optional[web.AppRunner] = None

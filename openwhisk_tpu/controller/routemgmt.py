@@ -124,6 +124,22 @@ class ApiRouteManager:
 
     async def _put_swagger(self, namespace: str, swagger: Dict[str, Any]
                            ) -> Dict[str, Any]:
+        # validate per-operation shape up front: match() relies on every
+        # operation carrying an x-openwhisk block naming the backing action
+        for rel, ops in (swagger.get("paths") or {}).items():
+            if not isinstance(ops, dict):
+                raise ApiManagementException(
+                    400, f"swagger path {rel!r} must map verbs to operations")
+            for verb, op in ops.items():
+                if verb not in VERBS:
+                    raise ApiManagementException(
+                        400, f"Invalid verb {verb!r} at swagger path {rel!r}")
+                xow = op.get("x-openwhisk") if isinstance(op, dict) else None
+                if not isinstance(xow, dict) or "namespace" not in xow \
+                        or "action" not in xow:
+                    raise ApiManagementException(
+                        400, f"operation {verb} {rel} must carry an "
+                             "x-openwhisk block with namespace and action")
         base_path = _normalize_base_path(swagger.get("basePath", "/"))
         doc_id = _doc_id(namespace, base_path)
         try:
@@ -225,7 +241,7 @@ class ApiRouteManager:
             rel = path[len(base.rstrip("/")):] or "/"
             ops = doc.get("swagger", {}).get("paths", {}).get(rel, {})
             op = ops.get(verb)
-            if op is not None:
+            if isinstance(op, dict) and isinstance(op.get("x-openwhisk"), dict):
                 best = op["x-openwhisk"]
                 best_len = len(base)
         return best

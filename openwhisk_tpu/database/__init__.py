@@ -1,9 +1,14 @@
 from .store import (ArtifactStore, ArtifactStoreException, DocumentConflict,
                     NoDocumentException, StaleParameter)
+from .attachment_store import (AttachmentStore, FileAttachmentStore,
+                               FileAttachmentStoreProvider,
+                               MemoryAttachmentStore,
+                               MemoryAttachmentStoreProvider)
 from .memory_store import MemoryArtifactStore, MemoryArtifactStoreProvider
 from .sqlite_store import SqliteArtifactStore, SqliteArtifactStoreProvider
 from .batcher import Batcher
 from .cache import EntityCache, RemoteCacheInvalidation
+from .change_feed import CacheInvalidatorService
 from .entities import EntityStore, AuthStore
 from .activation_store import (ActivationStore, ArtifactActivationStore,
                                ArtifactActivationStoreProvider,

@@ -73,8 +73,7 @@ class EntityStore:
         return entity.rev
 
     async def get(self, cls: Type, doc_id: str, use_cache: bool = True):
-        async def load_once():
-            doc = await self.store.get(doc_id)
+        async def materialize(doc):
             exec_json = doc.get("exec")
             if isinstance(exec_json, dict) and isinstance(exec_json.get("code"), dict):
                 _, data = await self.store.read_attachment(
@@ -85,13 +84,14 @@ class EntityStore:
             return ent
 
         async def load():
+            doc = await self.store.get(doc_id)  # missing doc: raise directly
             try:
-                return await load_once()
+                return await materialize(doc)
             except NoDocumentException:
-                # a concurrent update may have GC'd the attachment we read the
-                # stub for between our two reads — the fresh doc names the
-                # current attachment, so one retry settles it
-                return await load_once()
+                # a concurrent update GC'd the attachment our stale stub
+                # named — the re-fetched doc names the current attachment
+                doc = await self.store.get(doc_id)
+                return await materialize(doc)
 
         if use_cache:
             return await self.cache.get_or_load(doc_id, load)

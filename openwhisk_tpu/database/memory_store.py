@@ -75,9 +75,14 @@ class MemoryArtifactStore(ArtifactStore):
 
     async def attach(self, doc_id: str, name: str, content_type: str,
                      data: bytes) -> None:
+        if self.attachment_store is not None:
+            return await self.attachment_store.attach(doc_id, name,
+                                                      content_type, data)
         self._attachments.setdefault(doc_id, {})[name] = (content_type, bytes(data))
 
     async def read_attachment(self, doc_id: str, name: str) -> Tuple[str, bytes]:
+        if self.attachment_store is not None:
+            return await self.attachment_store.read_attachment(doc_id, name)
         try:
             return self._attachments[doc_id][name]
         except KeyError:
@@ -85,6 +90,9 @@ class MemoryArtifactStore(ArtifactStore):
 
     async def delete_attachments(self, doc_id: str,
                                  except_name: Optional[str] = None) -> None:
+        if self.attachment_store is not None:
+            return await self.attachment_store.delete_attachments(
+                doc_id, except_name=except_name)
         if except_name is None:
             self._attachments.pop(doc_id, None)
         elif doc_id in self._attachments:

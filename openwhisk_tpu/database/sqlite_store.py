@@ -198,6 +198,9 @@ class SqliteArtifactStore(ArtifactStore):
     # -- attachments -------------------------------------------------------
     async def attach(self, doc_id: str, name: str, content_type: str,
                      data: bytes) -> None:
+        if self.attachment_store is not None:
+            return await self.attachment_store.attach(doc_id, name,
+                                                      content_type, data)
         def go():
             with self._conn() as conn:
                 conn.execute(
@@ -206,6 +209,8 @@ class SqliteArtifactStore(ArtifactStore):
         await self._run(go)
 
     async def read_attachment(self, doc_id: str, name: str) -> Tuple[str, bytes]:
+        if self.attachment_store is not None:
+            return await self.attachment_store.read_attachment(doc_id, name)
         def go():
             row = self._conn().execute(
                 "SELECT content_type, data FROM attachments WHERE doc_id=? AND name=?",
@@ -217,6 +222,9 @@ class SqliteArtifactStore(ArtifactStore):
 
     async def delete_attachments(self, doc_id: str,
                                  except_name: Optional[str] = None) -> None:
+        if self.attachment_store is not None:
+            return await self.attachment_store.delete_attachments(
+                doc_id, except_name=except_name)
         def go():
             with self._conn() as conn:
                 if except_name is None:
@@ -229,6 +237,7 @@ class SqliteArtifactStore(ArtifactStore):
         await self._run(go)
 
     async def close(self) -> None:
+        await super().close()
         with self._init_lock:
             for c in self._conns:
                 try:

@@ -35,6 +35,15 @@ class ArtifactStore:
     """Async document store. Documents are JSON dicts with `_id` and `_rev`
     managed by the store; callers hand in entity JSON + doc id."""
 
+    # optional delegation of attachment bytes to a separate AttachmentStore
+    # (ref: CouchDbRestStore takes an attachmentStore; reference.conf wires
+    # S3AttachmentStoreProvider in that slot)
+    attachment_store = None
+
+    def with_attachment_store(self, attachment_store) -> "ArtifactStore":
+        self.attachment_store = attachment_store
+        return self
+
     # -- CRUD --------------------------------------------------------------
     async def put(self, doc_id: str, doc: Dict[str, Any],
                   rev: Optional[str] = None) -> str:
@@ -84,7 +93,8 @@ class ArtifactStore:
         raise NotImplementedError
 
     async def close(self) -> None:
-        pass
+        if self.attachment_store is not None:
+            await self.attachment_store.close()
 
 
 def match_query(doc: Dict[str, Any], collection: str, namespace: Optional[str],

@@ -109,7 +109,9 @@ class EdgeProxy:
                 url = op.get("url", "")
                 # strip any host prefix the route doc may carry
                 if "://" in url:
-                    url = "/" + url.split("://", 1)[1].split("/", 1)[1]
+                    rest = url.split("://", 1)[1]
+                    _, _, tail = rest.partition("/")
+                    url = "/" + tail
                 return url
         # no API path, no vanity host, no gateway route: nothing to serve
         raise web.HTTPNotFound(text="no route")
@@ -140,10 +142,22 @@ class EdgeProxy:
                     out_headers[TRANSACTION_HEADER] = transid
                     return web.Response(status=resp.status, body=payload,
                                         headers=out_headers)
-            except (aiohttp.ClientConnectionError, asyncio.TimeoutError) as e:
+            except aiohttp.ClientConnectorError as e:
+                # connect failed — the request was never sent, so retrying
+                # the next upstream is safe for ANY method; blacklist this
+                # upstream for fail_timeout (nginx `fail_timeout=60s`)
                 upstream.fails += 1
                 upstream.fail_until = time.monotonic() + self.fail_timeout
                 last_error = e
+            except (aiohttp.ClientConnectionError, asyncio.TimeoutError):
+                # the request may already be executing upstream (e.g. a slow
+                # blocking invoke hit read_timeout): do NOT re-send non-
+                # idempotent methods (nginx proxy_next_upstream excludes
+                # them), and a slow request is no reason to blacklist
+                if request.method in ("GET", "HEAD", "OPTIONS"):
+                    last_error = RuntimeError("upstream read failed")
+                    continue
+                return web.Response(status=504, text="upstream timeout")
         return web.Response(status=502, text=f"no upstream available: {last_error}")
 
     def _pick_order(self) -> List[Upstream]:

@@ -16,8 +16,11 @@ import importlib
 import os
 from typing import Any, Callable, Dict
 
-# the ten reference extension points (reference.conf:20-31)
+# the reference extension points (reference.conf:20-31), plus the
+# AttachmentStore seam (reference: S3AttachmentStoreProvider wired into the
+# artifact store's attachment slot)
 SPI_NAMES = (
+    "AttachmentStoreProvider",
     "ArtifactStoreProvider",
     "ActivationStoreProvider",
     "MessagingProvider",
@@ -31,6 +34,7 @@ SPI_NAMES = (
 )
 
 _DEFAULTS: Dict[str, str] = {
+    "AttachmentStoreProvider": "openwhisk_tpu.database.attachment_store:MemoryAttachmentStoreProvider",
     "ArtifactStoreProvider": "openwhisk_tpu.database.memory_store:MemoryArtifactStoreProvider",
     "ActivationStoreProvider": "openwhisk_tpu.database.activation_store:ArtifactActivationStoreProvider",
     "MessagingProvider": "openwhisk_tpu.messaging.memory:MemoryMessagingProvider",

@@ -212,3 +212,130 @@ class TestCacheInvalidation:
             await r0.stop()
             await r1.stop()
         run(go())
+
+
+class TestAttachmentStore:
+    """AttachmentStore SPI (ref S3AttachmentStore / MemoryAttachmentStore):
+    artifact stores delegate attachment bytes to a separate blob store."""
+
+    def _contract(self, make):
+        async def go():
+            att = make()
+            await att.attach("ns/act", "codefile-a", "text/plain", b"AAA")
+            await att.attach("ns/act", "codefile-b", "application/x", b"BBB")
+            ctype, data = await att.read_attachment("ns/act", "codefile-b")
+            assert (ctype, data) == ("application/x", b"BBB")
+            # GC all but one (the winner's per-revision blob)
+            await att.delete_attachments("ns/act", except_name="codefile-b")
+            with pytest.raises(NoDocumentException):
+                await att.read_attachment("ns/act", "codefile-a")
+            assert (await att.read_attachment("ns/act", "codefile-b"))[1] == b"BBB"
+            # full delete
+            await att.delete_attachments("ns/act")
+            with pytest.raises(NoDocumentException):
+                await att.read_attachment("ns/act", "codefile-b")
+            await att.close()
+        run(go())
+
+    def test_memory_contract(self):
+        from openwhisk_tpu.database import MemoryAttachmentStore
+        self._contract(MemoryAttachmentStore)
+
+    def test_file_contract_and_durability(self):
+        from openwhisk_tpu.database import FileAttachmentStore
+        with tempfile.TemporaryDirectory() as d:
+            self._contract(lambda: FileAttachmentStore(d))
+
+            async def durability():
+                a1 = FileAttachmentStore(d)
+                await a1.attach("guest/big", "codefile-x", "text/plain",
+                                b"persisted")
+                # a fresh instance over the same dir sees the blob
+                a2 = FileAttachmentStore(d)
+                ctype, data = await a2.read_attachment("guest/big", "codefile-x")
+                assert data == b"persisted" and ctype == "text/plain"
+            run(durability())
+
+    def test_artifact_store_delegation_large_code(self):
+        """EntityStore's >64KB attachment path lands in the delegated
+        AttachmentStore, not the artifact store's own table."""
+        from openwhisk_tpu.database import MemoryAttachmentStore
+        async def go():
+            att = MemoryAttachmentStore()
+            store = MemoryArtifactStore().with_attachment_store(att)
+            es = EntityStore(store)
+            big = "x" * (EntityStore.ATTACHMENT_THRESHOLD + 1)
+            action = WhiskAction(EntityPath("guest"), EntityName("big"),
+                                 CodeExec(kind="python:3", code=big))
+            await es.put(action)
+            assert att.attachment_count == 1
+            assert store._attachments == {}  # bytes did NOT land inline
+            got = await es.get_action("guest/big")
+            assert got.exec.code == big
+            # update GCs the superseded blob in the delegate
+            action2 = await es.get_action("guest/big")
+            action2.exec.code = big + "y"
+            await es.put(action2)
+            assert att.attachment_count == 1
+            await es.delete(await es.get_action("guest/big"))
+            assert att.attachment_count == 0
+        run(go())
+
+    def test_spi_resolution(self):
+        from openwhisk_tpu import spi
+        from openwhisk_tpu.database import MemoryAttachmentStore
+        provider = spi.get("AttachmentStoreProvider")
+        assert isinstance(provider.make_store(), MemoryAttachmentStore)
+
+
+class TestChangeFeedBridge:
+    """core/cosmosdb/cache-invalidator equivalent: store changes made by an
+    external writer are bridged onto the cacheInvalidation topic."""
+
+    def test_external_write_evicts_controller_caches(self):
+        async def go():
+            from openwhisk_tpu.database import CacheInvalidatorService
+            provider = MemoryMessagingProvider()
+            store = MemoryArtifactStore()
+            cache = EntityCache()
+            rci = RemoteCacheInvalidation(provider, "controller0",
+                                          {"whisks": cache})
+            rci.start()
+            svc = CacheInvalidatorService(store, provider, poll_interval=0.05)
+
+            # controller has guest/hello cached; an EXTERNAL writer updates
+            # the doc directly in the shared store
+            cache.update("guest/hello", "stale-value")
+            import time as _t
+            await store.put("guest/hello", {
+                "_id": "guest/hello", "entityType": "actions",
+                "namespace": "guest", "name": "hello", "updated": _t.time()})
+
+            n = await svc.poll_once()
+            assert n == 1
+            await asyncio.sleep(0.1)  # let the feed deliver
+            assert "guest/hello" not in cache
+
+            # steady state: nothing new → no events
+            assert await svc.poll_once() == 0
+            await rci.stop()
+        run(go())
+
+    def test_start_stop_loop(self):
+        async def go():
+            from openwhisk_tpu.database import CacheInvalidatorService
+            provider = MemoryMessagingProvider()
+            store = MemoryArtifactStore()
+            svc = CacheInvalidatorService(store, provider, poll_interval=0.02)
+            svc.start()
+            import time as _t
+            await store.put("guest/x", {
+                "_id": "guest/x", "entityType": "triggers",
+                "namespace": "guest", "name": "x", "updated": _t.time()})
+            for _ in range(50):
+                if svc.events_published >= 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert svc.events_published >= 1
+            await svc.stop()
+        run(go())

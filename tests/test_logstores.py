@@ -1,0 +1,89 @@
+"""LogStore SPI variants (ref core/containerpool/logging/): the log-driver
+no-op store and the remote fetch-side stores (Elastic/Splunk equivalents)."""
+import asyncio
+import time
+
+from openwhisk_tpu.containerpool.logstore import (ContainerLogStore,
+                                                  ElasticSearchLogStore,
+                                                  LogDriverLogStore,
+                                                  SplunkLogStore)
+from openwhisk_tpu.core.entity import (ActivationId, EntityName, EntityPath,
+                                       Subject, WhiskActivation)
+from openwhisk_tpu.standalone import guest_identity
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_activation(logs=None):
+    return WhiskActivation(EntityPath("guest"), EntityName("hello"),
+                           Subject("guest-subject"), ActivationId.generate(),
+                           start=time.time(), logs=logs)
+
+
+class FakeHttp:
+    """Injected transport capturing the request and replaying a response."""
+
+    def __init__(self, response):
+        self.response = response
+        self.calls = []
+
+    async def __call__(self, method, url, body, headers):
+        self.calls.append((method, url, body, headers))
+        return self.response
+
+
+class TestLogStores:
+    def test_default_store_fetch_reads_record(self):
+        async def go():
+            store = ContainerLogStore()
+            act = make_activation(logs=["stdout: hi"])
+            assert await store.fetch_logs(guest_identity(), act) == ["stdout: hi"]
+        run(go())
+
+    def test_log_driver_store_collects_nothing(self):
+        async def go():
+            store = LogDriverLogStore()
+            assert await store.collect_logs(None, None, None, None, None) == []
+            msg = await store.fetch_logs(guest_identity(), make_activation())
+            assert "not available" in msg[0]
+        run(go())
+
+    def test_elasticsearch_fetch(self):
+        async def go():
+            act = make_activation()
+            http = FakeHttp({"hits": {"hits": [
+                {"_source": {"time_date": "2026-01-01T00:00:00Z",
+                             "stream": "stdout", "message": "line one"}},
+                {"_source": {"time_date": "2026-01-01T00:00:01Z",
+                             "stream": "stderr", "message": "line two"}},
+            ]}})
+            store = ElasticSearchLogStore(http, "http://es:9200",
+                                          index_pattern="logs-{uuid}")
+            lines = await store.fetch_logs(guest_identity(), act)
+            assert lines == ["2026-01-01T00:00:00Z stdout: line one",
+                             "2026-01-01T00:00:01Z stderr: line two"]
+            method, url, body, _ = http.calls[0]
+            assert method == "POST" and url.endswith("/_search")
+            # per-user index substitution (ref path schema with {uuid})
+            assert guest_identity().namespace.uuid.asString in url
+            assert body["query"]["term"]["activation_id"] == \
+                act.activation_id.asString
+            # collection is out-of-band
+            assert await store.collect_logs(None, None, None, None, None) == []
+        run(go())
+
+    def test_splunk_fetch(self):
+        async def go():
+            act = make_activation()
+            http = FakeHttp({"results": [{"log_message": "alpha"},
+                                         {"log_message": "beta"}]})
+            store = SplunkLogStore(http, "https://splunk:8089", index="wsk")
+            lines = await store.fetch_logs(guest_identity(), act)
+            assert lines == ["alpha", "beta"]
+            _, url, body, _ = http.calls[0]
+            assert url.endswith("/services/search/jobs")
+            assert act.activation_id.asString in body["search"]
+            assert "index=wsk" in body["search"]
+        run(go())
