@@ -119,6 +119,16 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def main() -> None:
+    # memory cap: the process-level analogue of docker -m. Applied here (after
+    # exec) rather than via a parent preexec_fn — fork hooks are unsafe in a
+    # multithreaded parent (JAX), and the limit belongs to the sandbox anyway.
+    limit = os.environ.get("OW_MEMORY_LIMIT_BYTES")
+    if limit:
+        try:
+            import resource
+            resource.setrlimit(resource.RLIMIT_AS, (int(limit), int(limit)))
+        except (ValueError, OSError, ImportError):
+            pass
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     print(f"action proxy listening on {port}", flush=True)

@@ -74,15 +74,15 @@ class Container:
             self._session = aiohttp.ClientSession()
         return self._session
 
-    async def _post(self, path: str, payload: dict, timeout: float,
-                    retries: int = 100) -> Tuple[int, dict]:
+    async def _post(self, path: str, payload: dict, timeout: float
+                    ) -> Tuple[int, dict]:
         """POST with connect retries: a cold container's server may not be
         listening yet (the reference's HttpUtils retries until the socket
-        opens)."""
+        opens, bounded only by the caller's timeout)."""
         url = f"http://{self.addr[0]}:{self.addr[1]}{path}"
         last: Optional[Exception] = None
         deadline = time.monotonic() + timeout
-        for _ in range(retries):
+        while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break

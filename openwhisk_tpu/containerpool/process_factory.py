@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import asyncio
 import os
-import resource
 import signal
 import socket
 import sys
@@ -118,25 +117,18 @@ class ProcessContainerFactory(ContainerFactory):
             port = _free_port()
             fd_out, stdout_path = tempfile.mkstemp(prefix=f"ow-{name}-", suffix=".out")
             fd_err, stderr_path = tempfile.mkstemp(prefix=f"ow-{name}-", suffix=".err")
-            mem_bytes = memory.bytes
-
-            def preexec():
-                # memory cap: the process-level analogue of docker -m
-                try:
-                    # leave headroom for the interpreter itself
-                    resource.setrlimit(resource.RLIMIT_AS,
-                                       (mem_bytes + 512 * 1024 * 1024,) * 2)
-                except (ValueError, OSError):
-                    pass
-                os.setsid()
-
+            # memory cap is applied by the proxy itself after exec (a parent
+            # preexec_fn would fork() a multithreaded JAX process, which can
+            # deadlock the child before exec); leave interpreter headroom
+            env = dict(os.environ,
+                       OW_MEMORY_LIMIT_BYTES=str(memory.bytes + 512 * 1024 * 1024))
             # launch the proxy file directly (NOT -m): it is stdlib-only, so
             # this skips importing the parent package (aiohttp etc., ~2s)
             proxy_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                       "actionproxy.py")
             proc = await asyncio.create_subprocess_exec(
                 sys.executable, "-u", proxy_path, str(port),
-                stdout=fd_out, stderr=fd_err, preexec_fn=preexec,
+                stdout=fd_out, stderr=fd_err, start_new_session=True, env=env,
             )
             os.close(fd_out)
             os.close(fd_err)

@@ -1,0 +1,187 @@
+"""Shared scaffolding for the performance harness.
+
+Rebuild of the reference's tests/performance driver conventions
+(tests/performance/README.md:31-140): each simulation runs against a live
+system, reports latency/throughput statistics, and — exactly like the
+reference's Gatling assertions — fails the run only when an operator-supplied
+environment threshold is present and violated:
+
+  MEAN_RESPONSE_TIME / MAX_MEAN_RESPONSE_TIME   upper bounds, milliseconds
+  REQUESTS_PER_SEC   / MIN_REQUESTS_PER_SEC     lower bounds, requests/second
+
+Simulations here drive the in-process standalone server (the framework's
+single-host deployment) over real HTTP, so they measure the full stack:
+edge-less REST -> entitlement -> balancer -> bus -> invoker -> sandbox.
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import aiohttp  # noqa: E402
+
+NOOP_CODE = "def main(args):\n    return {'ok': True}\n"
+
+
+@dataclass
+class Stats:
+    """Latency/throughput summary over one simulation run."""
+    name: str
+    samples_ms: List[float]
+    wall_s: float
+    errors: int = 0
+
+    def _pct(self, p: float) -> float:
+        xs = sorted(self.samples_ms)
+        if not xs:
+            return float("nan")
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    @property
+    def mean_ms(self) -> float:
+        return sum(self.samples_ms) / max(len(self.samples_ms), 1)
+
+    @property
+    def rps(self) -> float:
+        return len(self.samples_ms) / self.wall_s if self.wall_s else 0.0
+
+    def row(self) -> dict:
+        return {
+            "simulation": self.name,
+            "requests": len(self.samples_ms),
+            "errors": self.errors,
+            "mean_ms": round(self.mean_ms, 2),
+            "p50_ms": round(self._pct(0.50), 2),
+            "p90_ms": round(self._pct(0.90), 2),
+            "p99_ms": round(self._pct(0.99), 2),
+            "rps": round(self.rps, 1),
+        }
+
+    def report(self) -> None:
+        print(json.dumps(self.row()))
+
+    def check_thresholds(self) -> bool:
+        """Apply the reference's env-var assertions; True = pass."""
+        ok = True
+        gated = any(os.environ.get(v) for v in
+                    ("MEAN_RESPONSE_TIME", "MAX_MEAN_RESPONSE_TIME",
+                     "REQUESTS_PER_SEC", "MIN_REQUESTS_PER_SEC"))
+        if gated and (self.errors or not self.samples_ms):
+            print(f"FAIL {self.name}: {self.errors} errors, "
+                  f"{len(self.samples_ms)} successful samples",
+                  file=sys.stderr)
+            return False
+        for var in ("MEAN_RESPONSE_TIME", "MAX_MEAN_RESPONSE_TIME"):
+            v = os.environ.get(var)
+            if v and self.mean_ms > float(v):
+                print(f"FAIL {self.name}: mean {self.mean_ms:.1f}ms > {var}={v}",
+                      file=sys.stderr)
+                ok = False
+        for var in ("REQUESTS_PER_SEC", "MIN_REQUESTS_PER_SEC"):
+            v = os.environ.get(var)
+            if v and self.rps < float(v):
+                print(f"FAIL {self.name}: {self.rps:.1f} rps < {var}={v}",
+                      file=sys.stderr)
+                ok = False
+        return ok
+
+
+class Client:
+    """Minimal authenticated REST client for the simulations."""
+
+    def __init__(self, session: aiohttp.ClientSession, base: str, uuid: str,
+                 key: str):
+        self.session = session
+        self.base = base
+        auth = base64.b64encode(f"{uuid}:{key}".encode()).decode()
+        self.headers = {"Authorization": f"Basic {auth}",
+                        "Content-Type": "application/json"}
+
+    async def put_action(self, name: str, code: str = NOOP_CODE,
+                         kind: str = "python:3", **fields) -> int:
+        async with self.session.put(
+                f"{self.base}/namespaces/_/actions/{name}?overwrite=true",
+                headers=self.headers,
+                json={"exec": {"kind": kind, "code": code}, **fields}) as r:
+            return r.status
+
+    async def invoke(self, name: str, payload: Optional[dict] = None,
+                     blocking: bool = True) -> tuple:
+        qs = "?blocking=true" if blocking else ""
+        async with self.session.post(
+                f"{self.base}/namespaces/_/actions/{name}{qs}",
+                headers=self.headers, json=payload or {}) as r:
+            return r.status, await r.json()
+
+    async def get(self, path: str) -> tuple:
+        async with self.session.get(f"{self.base}{path}",
+                                    headers=self.headers) as r:
+            return r.status, await r.json()
+
+    async def post(self, path: str, payload: Optional[dict] = None) -> tuple:
+        async with self.session.post(f"{self.base}{path}",
+                                     headers=self.headers,
+                                     json=payload or {}) as r:
+            body = await r.json() if r.content_type == "application/json" else {}
+            return r.status, body
+
+    async def delete(self, path: str) -> int:
+        async with self.session.delete(f"{self.base}{path}",
+                                       headers=self.headers) as r:
+            return r.status
+
+
+async def timed_loop(n_requests: int, concurrency: int,
+                     one: Callable[[int], Awaitable[bool]]) -> Stats:
+    """Run `one(i)` n_requests times at the given concurrency; time each."""
+    samples: List[float] = []
+    errors = 0
+    sem = asyncio.Semaphore(concurrency)
+
+    async def worker(i: int):
+        nonlocal errors
+        async with sem:
+            t0 = time.perf_counter()
+            try:
+                ok = await one(i)
+            except Exception as e:  # transport/parse errors count, not abort
+                print(f"request {i} failed: {e!r}", file=sys.stderr)
+                ok = False
+            dt = (time.perf_counter() - t0) * 1e3
+            if ok:
+                samples.append(dt)
+            else:
+                errors += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(i) for i in range(n_requests)))
+    wall = time.perf_counter() - t0
+    return Stats("", samples, wall, errors)
+
+
+def run_with_standalone(coro_fn, port: int = 13366, **standalone_kw):
+    """Boot the standalone server, run coro_fn(client), tear down."""
+    from openwhisk_tpu.standalone import (GUEST_KEY, GUEST_UUID,
+                                          make_standalone)
+
+    async def go():
+        controller = await make_standalone(port=port, **standalone_kw)
+        try:
+            async with aiohttp.ClientSession() as session:
+                client = Client(session, f"http://127.0.0.1:{port}/api/v1",
+                                GUEST_UUID, GUEST_KEY)
+                return await coro_fn(client)
+        finally:
+            await controller.stop()
+
+    return asyncio.run(go())

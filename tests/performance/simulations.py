@@ -1,0 +1,132 @@
+"""The four headline simulations of the reference performance harness.
+
+Parity with tests/performance (tests/performance/README.md):
+  latency     warm end-to-end blocking-invoke latency, concurrency 1
+              (wrk latency test :31-43 + Gatling LatencySimulation :88-121)
+  throughput  sustained blocking throughput on one warm action, concurrency C
+              (wrk throughput :45-52 + BlockingInvokeOneActionSimulation
+              :124-140)
+  cold        cold-start blocking throughput — every invoke hits a fresh
+              action so no warm container can be reused
+              (ColdBlockingInvokeSimulation)
+  apiv1       CRUD/API throughput over /api/v1 — put/get/list/delete cycle
+              (ApiV1Simulation :63-86)
+
+Thresholds come from the environment exactly as in the reference
+(MEAN_RESPONSE_TIME, MAX_MEAN_RESPONSE_TIME, REQUESTS_PER_SEC,
+MIN_REQUESTS_PER_SEC); without them the run is report-only.
+
+    python tests/performance/simulations.py latency --requests 100
+    python tests/performance/simulations.py all --requests 50 --concurrency 4
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+try:
+    from harness import Client, Stats, run_with_standalone, timed_loop
+except ImportError:  # imported as a package module (smoke tests)
+    from .harness import Client, Stats, run_with_standalone, timed_loop
+
+
+async def latency_simulation(client: Client, requests: int, **_) -> Stats:
+    """Warm latency at concurrency 1: one priming invoke, then the loop."""
+    assert await client.put_action("perf-latency") == 200
+    await client.invoke("perf-latency")
+
+    async def one(i: int) -> bool:
+        status, body = await client.invoke("perf-latency")
+        return status == 200 and body["response"]["success"]
+
+    stats = await timed_loop(requests, 1, one)
+    stats.name = "latency"
+    return stats
+
+
+async def throughput_simulation(client: Client, requests: int,
+                                concurrency: int, **_) -> Stats:
+    """Sustained blocking throughput on one warm action."""
+    assert await client.put_action("perf-throughput") == 200
+    # prime enough warm sandboxes to cover the concurrency
+    for _ in range(concurrency):
+        await client.invoke("perf-throughput")
+
+    async def one(i: int) -> bool:
+        status, _ = await client.invoke("perf-throughput")
+        return status == 200
+
+    stats = await timed_loop(requests, concurrency, one)
+    stats.name = "throughput"
+    return stats
+
+
+async def cold_simulation(client: Client, requests: int, concurrency: int,
+                          **_) -> Stats:
+    """Cold-start throughput: a distinct action per invoke (no warm reuse)."""
+    for i in range(requests):
+        assert await client.put_action(f"perf-cold-{i}") == 200
+
+    async def one(i: int) -> bool:
+        status, _ = await client.invoke(f"perf-cold-{i}")
+        return status == 200
+
+    stats = await timed_loop(requests, concurrency, one)
+    stats.name = "cold"
+    return stats
+
+
+async def apiv1_simulation(client: Client, requests: int, concurrency: int,
+                           **_) -> Stats:
+    """CRUD cycle throughput: PUT + GET + list + DELETE per iteration."""
+
+    async def one(i: int) -> bool:
+        name = f"perf-crud-{i}"
+        if await client.put_action(name) != 200:
+            return False
+        s1, _ = await client.get(f"/namespaces/_/actions/{name}")
+        s2, _ = await client.get("/namespaces/_/actions?limit=10")
+        s3 = await client.delete(f"/namespaces/_/actions/{name}")
+        return (s1, s2, s3) == (200, 200, 200)
+
+    stats = await timed_loop(requests, concurrency, one)
+    stats.name = "apiv1"
+    return stats
+
+
+SIMULATIONS = {
+    "latency": latency_simulation,
+    "throughput": throughput_simulation,
+    "cold": cold_simulation,
+    "apiv1": apiv1_simulation,
+}
+
+
+def run(names, requests: int, concurrency: int, port: int = 13366) -> bool:
+    """Run the named simulations against one standalone server; True=pass."""
+
+    async def go(client: Client):
+        results = []
+        for name in names:
+            stats = await SIMULATIONS[name](client, requests=requests,
+                                            concurrency=concurrency)
+            stats.report()
+            results.append(stats.check_thresholds())
+        return all(results)
+
+    return run_with_standalone(go, port=port)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("simulation", choices=[*SIMULATIONS, "all"])
+    ap.add_argument("--requests", type=int, default=100)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--port", type=int, default=13366)
+    args = ap.parse_args()
+    names = list(SIMULATIONS) if args.simulation == "all" else [args.simulation]
+    sys.exit(0 if run(names, args.requests, args.concurrency, args.port) else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Smoke coverage for the performance harness (tiny sample counts).
+
+Mirrors the reference's practice of keeping its perf harness compiling and
+runnable in CI even though real measurements need dedicated hardware: each
+tool runs end-to-end with minimal work so regressions surface in the unit
+suite, not on the benchmark box.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+PERF_DIR = os.path.join(os.path.dirname(__file__), "performance")
+sys.path.insert(0, PERF_DIR)
+
+import simulations  # noqa: E402
+
+
+class TestSimulations:
+    def test_latency_and_apiv1_report_stats(self, capsys):
+        ok = simulations.run(["latency", "apiv1"], requests=3, concurrency=2,
+                             port=13441)
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert ok
+        assert [l["simulation"] for l in lines] == ["latency", "apiv1"]
+        for l in lines:
+            assert l["errors"] == 0
+            assert l["requests"] == 3
+            assert l["rps"] > 0 and l["mean_ms"] > 0
+            assert l["p50_ms"] <= l["p99_ms"]
+
+    def test_threshold_violation_fails(self, capsys, monkeypatch):
+        monkeypatch.setenv("MIN_REQUESTS_PER_SEC", "1e12")
+        assert not simulations.run(["apiv1"], requests=2, concurrency=2,
+                                   port=13442)
+
+    def test_cold_and_throughput(self, capsys):
+        ok = simulations.run(["throughput", "cold"], requests=3, concurrency=2,
+                             port=13443)
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert ok and [l["errors"] for l in lines] == [0, 0]
+
+
+class TestPlacementSweep:
+    def test_single_and_sharded_rows(self):
+        import placement_sweep
+        row = placement_sweep.bench_single(16, batch=8, iters=2)
+        assert row["placements_per_sec"] > 0
+        row = placement_sweep.bench_sharded(64, batch=8, iters=2, n_shards=8)
+        assert row["config"] == "8-shard" and row["placements_per_sec"] > 0
+
+
+@pytest.mark.slow
+class TestOwperf:
+    def test_owperf_csv(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.join(PERF_DIR, "owperf.py"),
+             "--samples", "2", "--ratio", "1", "--port", "13444"],
+            capture_output=True, text=True, timeout=180, env=env)
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.strip().splitlines()
+        assert lines[0].startswith("phase,samples,mean_ms")
+        phases = [l.split(",")[0] for l in lines[1:]]
+        assert phases == ["action_e2e", "rule_e2e_x1", "waitTime", "initTime",
+                          "duration"]
