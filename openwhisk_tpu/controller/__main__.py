@@ -16,14 +16,17 @@ import asyncio
 from ..core.entity import ControllerInstanceId, ExecManifest, WhiskAuthRecord
 from ..database import SqliteArtifactStore
 from ..messaging.tcp import TcpMessagingProvider
+from ..utils.config import config_from_env
 from ..utils.logging import Logging
 from .core import Controller
+from ..utils.tasks import wait_for_shutdown
 
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="OpenWhisk-TPU controller")
     parser.add_argument("--bus", default="127.0.0.1:4222")
     parser.add_argument("--db", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=3233)
     parser.add_argument("--instance", default="0")
     parser.add_argument("--cluster-size", type=int, default=1)
@@ -49,18 +52,25 @@ def main() -> None:
             lb = ShardingBalancer(provider, instance, logger=logger,
                                   metrics=logger.metrics,
                                   cluster_size=args.cluster_size)
-        controller = Controller(instance, provider, artifact_store=store,
-                                logger=logger, load_balancer=lb)
+        # namespace default limits via the CONFIG_whisk_limits_* env channel
+        # (ref: LIMITS_ACTIONS_INVOKES_* in ansible/roles/controller/deploy.yml)
+        lim = config_from_env().get("limits", {})
+        controller = Controller(
+            instance, provider, artifact_store=store, logger=logger,
+            load_balancer=lb,
+            invocations_per_minute=int(lim.get("invocations_per_minute", 60)),
+            concurrent_invocations=int(lim.get("concurrent_invocations", 30)),
+            fires_per_minute=int(lim.get("fires_per_minute", 60)))
         if args.seed_guest:
             from ..standalone import guest_identity
             ident = guest_identity()
             await controller.auth_store.put(
                 WhiskAuthRecord(ident.subject, [ident.namespace], [ident.authkey]))
-        await controller.start(port=args.port)
+        await controller.start(host=args.host, port=args.port)
         print(f"controller{args.instance} up on :{args.port} "
               f"(balancer={args.balancer}, bus={args.bus})", flush=True)
         try:
-            await asyncio.Event().wait()
+            await wait_for_shutdown()
         finally:
             await controller.stop()
 

@@ -16,6 +16,7 @@ from typing import Optional
 from ..messaging.connector import MessageFeed
 from ..messaging.message import EventMessage
 from ..utils.logging import MetricEmitter
+from ..utils.tasks import wait_for_shutdown
 
 EVENTS_TOPIC = "events"
 
@@ -109,7 +110,7 @@ def main() -> None:
         await web.TCPSite(runner, "0.0.0.0", args.port).start()
         print(f"user-events metrics on :{args.port}/metrics", flush=True)
         try:
-            await asyncio.Event().wait()
+            await wait_for_shutdown()
         finally:
             await recorder.stop()
             await runner.cleanup()

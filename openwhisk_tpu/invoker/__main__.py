@@ -21,6 +21,7 @@ from ..utils.logging import Logging
 from .id_assigner import InstanceIdAssigner
 from .reactive import InvokerReactive
 from .server import InvokerServer
+from ..utils.tasks import wait_for_shutdown
 
 
 def main() -> None:
@@ -60,7 +61,7 @@ def main() -> None:
         print(f"invoker{instance_id} ({args.unique_name}) up — bus {args.bus}, "
               f"memory {args.memory}MB", flush=True)
         try:
-            await asyncio.Event().wait()
+            await wait_for_shutdown()
         finally:
             if server:
                 await server.stop()

@@ -5,6 +5,7 @@ import argparse
 import asyncio
 
 from .tcp import TcpBusServer
+from ..utils.tasks import wait_for_shutdown
 
 
 def main() -> None:
@@ -18,7 +19,7 @@ def main() -> None:
         await server.start()
         print(f"bus broker listening on {args.host}:{args.port}", flush=True)
         try:
-            await asyncio.Event().wait()
+            await wait_for_shutdown()
         finally:
             await server.stop()
 

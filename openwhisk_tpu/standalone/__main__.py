@@ -5,6 +5,7 @@ import argparse
 import asyncio
 
 from . import GUEST_KEY, GUEST_UUID, make_standalone
+from ..utils.tasks import wait_for_shutdown
 
 
 def main() -> None:
@@ -35,7 +36,7 @@ def main() -> None:
         print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")
         print(f"  API      http://127.0.0.1:{args.port}/api/v1")
         try:
-            await asyncio.Event().wait()
+            await wait_for_shutdown()
         finally:
             await controller.stop()
 

@@ -1,0 +1,271 @@
+"""owdeploy: cluster deployment tool — the ansible playbooks' role.
+
+The reference deploys with ansible (ansible/openwhisk.yml:18-34: zookeeper ->
+kafka -> controllers -> invokers -> nginx edge) parameterized by
+ansible/group_vars/all. This tool consumes the same shape of inventory (YAML
+or JSON; see deploy/cluster.yaml) and either
+
+  up / down / status    run the whole topology as supervised local processes
+                        (bus broker -> invokers -> controllers -> edge),
+                        pid-tracked under <rundir>;
+  render systemd        emit one unit file per service for a systemd host;
+  render k8s            emit Deployment/Service manifests for a cluster.
+
+Limits and feature tunables from the inventory's `limits:`/`config:` maps are
+exported as CONFIG_whisk_* environment variables, the same override channel
+the reference uses (docs/concurrency.md:28-40 convention).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_INVENTORY = {
+    "rundir": "ow-run",
+    "db": "whisks.db",
+    "bus": {"host": "127.0.0.1", "port": 4222},
+    "controllers": {"count": 1, "base_port": 3233, "balancer": "tpu"},
+    "invokers": {"count": 1, "memory_mb": 2048, "prewarm": False},
+    "edge": {"enabled": True, "port": 8080, "domain": ""},
+    "limits": {},   # e.g. invocationsPerMinute: 60  -> CONFIG_whisk_...
+    "config": {},   # raw CONFIG_whisk_* overrides
+}
+
+
+def load_inventory(path: Optional[str]) -> dict:
+    inv = json.loads(json.dumps(DEFAULT_INVENTORY))  # deep copy
+    if path:
+        with open(path) as f:
+            if path.endswith((".yaml", ".yml")):
+                import yaml
+                loaded = yaml.safe_load(f) or {}
+            else:
+                loaded = json.load(f)
+        for key, value in loaded.items():
+            if isinstance(value, dict) and isinstance(inv.get(key), dict):
+                inv[key].update(value)
+            else:
+                inv[key] = value
+    return inv
+
+
+def _config_env(inv: dict) -> Dict[str, str]:
+    """Only the inventory-derived CONFIG_* keys (what renderers persist)."""
+    env: Dict[str, str] = {}
+    for k, v in inv.get("limits", {}).items():
+        env[f"CONFIG_whisk_limits_{k}"] = str(v)
+    for k, v in inv.get("config", {}).items():
+        key = k if k.startswith("CONFIG_") else f"CONFIG_whisk_{k}"
+        env[key] = str(v)
+    return env
+
+
+def _env(inv: dict) -> Dict[str, str]:
+    return {**os.environ, **_config_env(inv)}
+
+
+def services(inv: dict, python: str = sys.executable,
+             net: Optional[Dict[str, str]] = None) -> List[dict]:
+    """The topology as an ordered service list (start order = list order).
+
+    `net` overrides how services bind and find each other, for rendered
+    targets where loopback is wrong: `bus_bind` (bus listen address),
+    `bus_host` (address others dial the bus at), `controller_host` (format
+    string with `{i}` for the edge's upstream list)."""
+    net = net or {}
+    bus = inv["bus"]
+    bus_addr = f"{net.get('bus_host', bus['host'])}:{bus['port']}"
+    ctrl_host = net.get("controller_host", "127.0.0.1")
+    db = inv["db"]
+    out = [{
+        "name": "bus",
+        "argv": [python, "-m", "openwhisk_tpu.messaging",
+                 "--host", net.get("bus_bind", bus["host"]),
+                 "--port", str(bus["port"])],
+    }]
+    for i in range(inv["invokers"]["count"]):
+        argv = [python, "-m", "openwhisk_tpu.invoker", "--bus", bus_addr,
+                "--db", db, "--unique-name", f"invoker-{i}",
+                "--memory", str(inv["invokers"]["memory_mb"])]
+        if inv["invokers"].get("prewarm"):
+            argv.append("--prewarm")
+        out.append({"name": f"invoker{i}", "argv": argv})
+    n_ctrl = inv["controllers"]["count"]
+    ctrl_urls = []
+    for i in range(n_ctrl):
+        port = inv["controllers"]["base_port"] + i
+        ctrl_urls.append(f"http://{ctrl_host.format(i=i)}:{port}")
+        argv = [python, "-m", "openwhisk_tpu.controller", "--bus", bus_addr,
+                "--host", net.get("controller_bind", "127.0.0.1"),
+                "--db", db, "--port", str(port), "--instance", str(i),
+                "--cluster-size", str(n_ctrl),
+                "--balancer", inv["controllers"].get("balancer", "tpu")]
+        if i == 0 and inv["controllers"].get("seed_guest", True):
+            argv.append("--seed-guest")
+        out.append({"name": f"controller{i}", "argv": argv})
+    if inv["edge"].get("enabled", True):
+        argv = [python, "-m", "openwhisk_tpu.edge",
+                "--port", str(inv["edge"]["port"]), "--controllers", *ctrl_urls]
+        if inv["edge"].get("domain"):
+            argv += ["--domain", inv["edge"]["domain"]]
+        out.append({"name": "edge", "argv": argv})
+    return out
+
+
+# ------------------------------------------------------------------ local up
+def up(inv: dict) -> None:
+    rundir = inv["rundir"]
+    os.makedirs(rundir, exist_ok=True)
+    env = _env(inv)
+    env.setdefault("PYTHONPATH", os.getcwd())
+    started = []
+    for svc in services(inv):
+        log = open(os.path.join(rundir, f"{svc['name']}.log"), "ab")
+        proc = subprocess.Popen(svc["argv"], stdout=log, stderr=log, env=env,
+                                start_new_session=True)
+        with open(os.path.join(rundir, f"{svc['name']}.pid"), "w") as f:
+            f.write(str(proc.pid))
+        started.append((svc["name"], proc.pid))
+        print(f"started {svc['name']} (pid {proc.pid})")
+        if svc["name"] == "bus":
+            time.sleep(1.0)  # services connect at boot; bus must be up first
+    print(f"{len(started)} services up; logs + pids in {rundir}/")
+
+
+def _pids(inv: dict) -> List[tuple]:
+    rundir = inv["rundir"]
+    out = []
+    if not os.path.isdir(rundir):
+        return out
+    for fn in sorted(os.listdir(rundir)):
+        if fn.endswith(".pid"):
+            with open(os.path.join(rundir, fn)) as f:
+                out.append((fn[:-4], int(f.read().strip())))
+    return out
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def down(inv: dict) -> None:
+    # reverse *start* order (edge -> controllers -> invokers -> bus) so the
+    # front stops admitting traffic before the workers go away
+    order = {s["name"]: i for i, s in enumerate(services(inv))}
+    tracked = sorted(_pids(inv), key=lambda p: order.get(p[0], -1))
+    for name, pid in reversed(tracked):
+        if _alive(pid):
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except OSError:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass  # exited between the liveness check and the signal
+            print(f"stopped {name} (pid {pid})")
+        os.unlink(os.path.join(inv["rundir"], f"{name}.pid"))
+
+
+def status(inv: dict) -> bool:
+    all_up = True
+    for name, pid in _pids(inv):
+        up_ = _alive(pid)
+        all_up &= up_
+        print(f"{name}: {'up' if up_ else 'DOWN'} (pid {pid})")
+    return all_up
+
+
+# ------------------------------------------------------------------ renderers
+def render_systemd(inv: dict, outdir: str) -> None:
+    os.makedirs(outdir, exist_ok=True)
+    env_lines = "".join(f"Environment={k}={v}\n"
+                        for k, v in _config_env(inv).items())
+    for svc in services(inv, python="/usr/bin/python3"):
+        after = "network.target" if svc["name"] == "bus" else "ow-bus.service"
+        unit = (f"[Unit]\nDescription=openwhisk-tpu {svc['name']}\n"
+                f"After={after}\n\n"
+                f"[Service]\nExecStart={shlex.join(svc['argv'])}\n"
+                f"WorkingDirectory=/opt/openwhisk-tpu\n{env_lines}"
+                "Restart=on-failure\nRestartSec=2\n\n"
+                "[Install]\nWantedBy=multi-user.target\n")
+        path = os.path.join(outdir, f"ow-{svc['name']}.service")
+        with open(path, "w") as f:
+            f.write(unit)
+        print(f"wrote {path}")
+
+
+def render_k8s(inv: dict, outdir: str) -> None:
+    import yaml
+    os.makedirs(outdir, exist_ok=True)
+    docs = []
+    ports = {"bus": inv["bus"]["port"], "edge": inv["edge"]["port"]}
+    # pods find each other via their Service DNS names, not loopback
+    net = {"bus_bind": "0.0.0.0", "bus_host": "ow-bus",
+           "controller_bind": "0.0.0.0", "controller_host": "ow-controller{i}"}
+    for svc in services(inv, python="python3", net=net):
+        name = f"ow-{svc['name']}"
+        container = {"name": name, "image": "openwhisk-tpu:latest",
+                     "command": svc["argv"],
+                     "env": [{"name": k, "value": v}
+                             for k, v in _config_env(inv).items()]}
+        docs.append({"apiVersion": "apps/v1", "kind": "Deployment",
+                     "metadata": {"name": name},
+                     "spec": {"replicas": 1,
+                              "selector": {"matchLabels": {"app": name}},
+                              "template": {
+                                  "metadata": {"labels": {"app": name}},
+                                  "spec": {"containers": [container]}}}})
+        port = ports.get(svc["name"])
+        if svc["name"].startswith("controller"):
+            port = inv["controllers"]["base_port"] + int(svc["name"][10:])
+        if port:
+            docs.append({"apiVersion": "v1", "kind": "Service",
+                         "metadata": {"name": name},
+                         "spec": {"selector": {"app": name},
+                                  "ports": [{"port": port,
+                                             "targetPort": port}]}})
+    path = os.path.join(outdir, "openwhisk-tpu.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump_all(docs, f, sort_keys=False)
+    print(f"wrote {path} ({len(docs)} manifests)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description="OpenWhisk-TPU deployer")
+    parser.add_argument("-i", "--inventory", default=None,
+                        help="inventory file (yaml or json)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("up")
+    sub.add_parser("down")
+    sub.add_parser("status")
+    render = sub.add_parser("render")
+    render.add_argument("target", choices=("systemd", "k8s"))
+    render.add_argument("-o", "--outdir", default="deploy/out")
+    args = parser.parse_args(argv)
+
+    inv = load_inventory(args.inventory)
+    if args.cmd == "up":
+        up(inv)
+    elif args.cmd == "down":
+        down(inv)
+    elif args.cmd == "status":
+        return 0 if status(inv) else 1
+    elif args.cmd == "render":
+        (render_systemd if args.target == "systemd" else render_k8s)(
+            inv, args.outdir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,3 +26,20 @@ def spawn(coro: Coroutine, logger=None, name: Optional[str] = None) -> asyncio.T
 
     task.add_done_callback(_done)
     return task
+
+
+async def wait_for_shutdown() -> None:
+    """Block until SIGTERM/SIGINT so service mains can run their `finally`
+    cleanup (destroy sandboxes, close servers). A bare
+    `await asyncio.Event().wait()` dies uncleanly on SIGTERM — the default
+    handler terminates the process before any cleanup runs."""
+    import signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+    await stop.wait()

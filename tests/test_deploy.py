@@ -1,0 +1,142 @@
+"""Deployer tests: inventory loading, renderers, and a real local `up`.
+
+The reference validates its deployment path by running the ansible playbooks
+in CI; here the equivalent is owdeploy bringing up the full topology (bus,
+invoker, controller, edge) as OS processes and serving an invoke through the
+edge proxy.
+"""
+import base64
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from openwhisk_tpu.tools import deploy  # noqa: E402
+
+
+class TestInventoryAndRenderers:
+    def test_defaults_and_overrides(self, tmp_path):
+        path = tmp_path / "inv.yaml"
+        path.write_text("controllers:\n  count: 3\nlimits:\n"
+                        "  invocationsPerMinute: 7\n")
+        inv = deploy.load_inventory(str(path))
+        assert inv["controllers"]["count"] == 3
+        assert inv["controllers"]["base_port"] == 3233  # default survives
+        assert inv["invokers"]["count"] == 1
+        env = deploy._env(inv)
+        assert env["CONFIG_whisk_limits_invocationsPerMinute"] == "7"
+
+    def test_service_topology_order(self):
+        inv = deploy.load_inventory(None)
+        inv["controllers"]["count"] = 2
+        inv["invokers"]["count"] = 2
+        names = [s["name"] for s in deploy.services(inv)]
+        assert names == ["bus", "invoker0", "invoker1", "controller0",
+                         "controller1", "edge"]
+        # cluster-size flows to every controller
+        ctrl = [s for s in deploy.services(inv) if s["name"] == "controller1"]
+        assert "--cluster-size" in ctrl[0]["argv"]
+        i = ctrl[0]["argv"].index("--cluster-size")
+        assert ctrl[0]["argv"][i + 1] == "2"
+
+    def test_render_systemd(self, tmp_path):
+        inv = deploy.load_inventory(None)
+        deploy.render_systemd(inv, str(tmp_path))
+        units = sorted(os.listdir(tmp_path))
+        assert "ow-bus.service" in units and "ow-edge.service" in units
+        body = (tmp_path / "ow-controller0.service").read_text()
+        assert "ExecStart=" in body and "After=ow-bus.service" in body
+
+    def test_render_k8s(self, tmp_path):
+        inv = deploy.load_inventory(None)
+        inv["limits"] = {"invocationsPerMinute": 9}
+        deploy.render_k8s(inv, str(tmp_path))
+        docs = list(yaml.safe_load_all(
+            (tmp_path / "openwhisk-tpu.yaml").read_text()))
+        kinds = [d["kind"] for d in docs]
+        assert kinds.count("Deployment") == 4  # bus, invoker, controller, edge
+        assert "Service" in kinds
+        ctrl = next(d for d in docs if d["metadata"]["name"] == "ow-controller0"
+                    and d["kind"] == "Deployment")
+        env = ctrl["spec"]["template"]["spec"]["containers"][0]["env"]
+        assert {"name": "CONFIG_whisk_limits_invocationsPerMinute",
+                "value": "9"} in env
+        # pods talk over Service DNS names, never loopback
+        cmd = ctrl["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "ow-bus:4222" in cmd and "0.0.0.0" in cmd
+        edge = next(d for d in docs if d["metadata"]["name"] == "ow-edge"
+                    and d["kind"] == "Deployment")
+        ecmd = edge["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "http://ow-controller0:3233" in ecmd
+        assert not any("127.0.0.1" in c for d in docs
+                       if d["kind"] == "Deployment"
+                       for c in d["spec"]["template"]["spec"]["containers"][0]["command"])
+
+    def test_render_does_not_leak_ambient_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CONFIG_whisk_debug_ambient", "1")
+        inv = deploy.load_inventory(None)
+        deploy.render_systemd(inv, str(tmp_path))
+        body = (tmp_path / "ow-controller0.service").read_text()
+        assert "ambient" not in body
+
+
+@pytest.mark.slow
+class TestLocalUp:
+    def test_up_status_invoke_down(self, tmp_path):
+        import asyncio
+
+        import aiohttp
+
+        inv = deploy.load_inventory(None)
+        inv["rundir"] = str(tmp_path / "run")
+        inv["db"] = str(tmp_path / "whisks.db")
+        inv["bus"]["port"] = 14222
+        inv["controllers"].update(count=1, base_port=13321, balancer="sharding")
+        inv["edge"]["port"] = 13881
+        os.environ.setdefault("PYTHONPATH", REPO)
+        cwd = os.getcwd()
+        os.chdir(REPO)
+        try:
+            deploy.up(inv)
+            from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID
+            auth = "Basic " + base64.b64encode(
+                f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+            hdrs = {"Authorization": auth, "Content-Type": "application/json"}
+            base = "http://127.0.0.1:13881/api/v1"  # through the edge
+
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    for _ in range(120):
+                        try:
+                            async with s.get("http://127.0.0.1:13321/invokers",
+                                             headers=hdrs) as r:
+                                if r.status == 200 and "up" in await r.text():
+                                    break
+                        except aiohttp.ClientError:
+                            pass
+                        await asyncio.sleep(0.5)
+                    else:
+                        raise AssertionError("fleet never became healthy")
+                    async with s.put(f"{base}/namespaces/_/actions/dep",
+                                     headers=hdrs,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": "def main(a):\n    return {'deployed': True}"}}) as r:
+                        assert r.status == 200, await r.text()
+                    async with s.post(
+                            f"{base}/namespaces/_/actions/dep?blocking=true&result=true",
+                            headers=hdrs, json={}) as r:
+                        return r.status, await r.json()
+
+            assert deploy.status(inv)
+            status, body = asyncio.run(drive())
+            assert (status, body) == (200, {"deployed": True})
+        finally:
+            deploy.down(inv)
+            os.chdir(cwd)
+        assert deploy._pids(inv) == []
