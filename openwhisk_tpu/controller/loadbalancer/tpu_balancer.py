@@ -99,9 +99,10 @@ class TpuBalancer(CommonLoadBalancer):
                  managed_fraction: float = 0.9, blackbox_fraction: float = 0.1,
                  batch_window: float = 0.002, max_batch: int = 256,
                  action_slots: int = 4096, initial_pad: int = 64,
-                 mesh=None):
+                 mesh=None, kernel: str = "xla"):
         super().__init__(messaging_provider, controller_instance, logger, metrics)
         self._cluster_size = cluster_size
+        self.kernel = kernel  # "xla" | "pallas" (single-device only)
         self.managed_fraction = managed_fraction
         self.blackbox_fraction = blackbox_fraction
         self.batch_window = batch_window
@@ -150,10 +151,40 @@ class TpuBalancer(CommonLoadBalancer):
             self.state = shard_state(state, self.mesh)
             self._sched_fn = make_sharded_schedule(self.mesh)
             self._release_fn = make_sharded_release(self.mesh)
+        elif self.kernel == "pallas" and self._pallas_fits():
+            from ...ops.placement_pallas import (schedule_batch_pallas,
+                                                 to_transposed)
+            interpret = jax.default_backend() == "cpu"
+
+            @jax.jit
+            def sched(st, batch):
+                # kernel layout is conc-transposed; state everywhere else
+                # stays [N, A]. Converting inside jit keeps both transposes
+                # on-device in the same program as the kernel call.
+                ts, chosen, forced = schedule_batch_pallas(
+                    to_transposed(st), batch, interpret=interpret)
+                return (PlacementState(ts.free_mb, ts.conc_free.T,
+                                       ts.health), chosen, forced)
+
+            self.state = state
+            self._sched_fn = sched
+            self._release_fn = release_batch
         else:
             self.state = state
             self._sched_fn = schedule_batch
             self._release_fn = release_batch
+
+    def _pallas_fits(self) -> bool:
+        from ...ops.placement_pallas import fits_vmem
+        if fits_vmem(self._n_pad, self.action_slots):
+            return True
+        if self.logger:
+            self.logger.warn(
+                None, f"pallas kernel needs VMEM-resident state; "
+                f"{self._n_pad}x{self.action_slots} does not fit — "
+                "using the XLA kernel")
+        self.kernel = "xla"
+        return False
 
     def _slot_mb(self, user_memory_mb: int) -> int:
         return max(user_memory_mb // self._cluster_size, MIN_SLOT_MB)
@@ -202,6 +233,10 @@ class TpuBalancer(CommonLoadBalancer):
             from ...parallel.sharded_state import shard_state
             state = shard_state(state, self.mesh)
         self.state = state
+        if self.kernel == "pallas" and not self._pallas_fits():
+            # grown past the VMEM budget: swap in the XLA kernel
+            self._sched_fn = schedule_batch
+            self._release_fn = release_batch
 
     def _recompute_partitions(self) -> None:
         n = len(self._registry)

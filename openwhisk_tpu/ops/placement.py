@@ -44,6 +44,22 @@ import jax
 import jax.numpy as jnp
 
 
+def _mulmod(a, b, m):
+    """(a % m) * b % m without int32 overflow, for b < m <= 2**17.
+
+    The naive product overflows int32 once partition sizes pass ~46k (e.g.
+    the 64k-invoker configuration with a large step inverse), corrupting
+    probe ranks. Splitting b = hi*512 + lo keeps every intermediate under
+    2**26: a' < 2**17, hi < 2**8, lo < 2**9.
+    """
+    a = jnp.mod(a, m)
+    hi = b // 512
+    lo = b - hi * 512
+    t = jnp.mod(a * hi, m)
+    t = jnp.mod(t * 512, m)
+    return jnp.mod(t + a * lo, m)
+
+
 class PlacementState(NamedTuple):
     free_mb: jax.Array    # int32[N]
     conc_free: jax.Array  # int32[N, A]
@@ -92,7 +108,7 @@ def _schedule_one(state: PlacementState, req) -> Tuple[PlacementState, Tuple]:
     in_part = (local >= 0) & (local < size)
     size_safe = jnp.maximum(size, 1)
     # probe-order rank via modular inverse of the coprime step
-    rank = jnp.mod((local - home) * step_inv, size_safe)
+    rank = _mulmod(local - home, step_inv, size_safe)
 
     conc_col = jax.lax.dynamic_index_in_dim(state.conc_free, slot, axis=1,
                                             keepdims=False)

@@ -1,0 +1,162 @@
+"""Pallas TPU kernel for batched placement.
+
+The XLA path (ops/placement.py) lowers the per-request reduction through
+`lax.scan`; this kernel instead runs the whole micro-batch inside ONE
+pallas_call with the fleet state resident in VMEM across all B iterations —
+no per-iteration HBM round-trips for the capacity books, and the request
+columns live in SMEM as scalars.
+
+Layout notes (TPU tiling wants the fleet on the 128-lane axis):
+  free    int32[1, N]   free memory permits
+  health  int32[1, N]   usable mask (0/1)
+  conc_t  int32[A, N]   spare concurrency permits, TRANSPOSED vs the XLA
+                        kernel's [N, A] so a request's action-slot row is a
+                        contiguous [1, N] vector.
+  reqs    int32[B, 10]  (offset, size, home, step_inv, need, slot, max_conc,
+                        rand, valid, slot_in_range) per request, in SMEM.
+
+Semantics are identical to ops/placement.py::schedule_batch (asserted by
+tests in interpret mode): same probe-rank argmin, same forced placement,
+same NestedSemaphore capacity updates, same sequential intra-batch
+resolution. VMEM budget caps the fleet at roughly N*A*4 bytes ~ a few MB;
+`fits_vmem` reports whether a configuration qualifies (larger fleets use the
+XLA/sharded path).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .placement import PlacementState, RequestBatch, _mulmod
+
+# VMEM is ~16 MB/core; leave room for double-buffering and the runtime
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def fits_vmem(n_pad: int, action_slots: int) -> bool:
+    return (action_slots + 2) * n_pad * 4 <= _VMEM_BUDGET_BYTES
+
+
+def to_transposed(state: PlacementState) -> PlacementState:
+    """Standard [N, A] state <-> kernel layout ([A, N] conc). Involution."""
+    return PlacementState(state.free_mb, state.conc_free.T,
+                          state.health)
+
+
+def _kernel(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref, forced_ref,
+            free_out, conc_out):
+    n = free_out.shape[1]
+    b = chosen_ref.shape[1]
+    big = jnp.int32(n + 2)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    bidx = jax.lax.broadcasted_iota(jnp.int32, (1, b), 1)
+
+    # state starts in the aliased output buffers
+    free_out[:] = free_ref[:]
+    conc_out[:] = conc_ref[:]
+    chosen_ref[:] = jnp.full((1, b), -1, jnp.int32)
+    forced_ref[:] = jnp.zeros((1, b), jnp.int32)
+
+    def body(i, _):
+        offset = reqs_ref[i, 0]
+        size = reqs_ref[i, 1]
+        home = reqs_ref[i, 2]
+        step_inv = reqs_ref[i, 3]
+        need = reqs_ref[i, 4]
+        slot = reqs_ref[i, 5]
+        max_conc = reqs_ref[i, 6]
+        rand = reqs_ref[i, 7]
+        valid = reqs_ref[i, 8] > 0
+        slot_ok = reqs_ref[i, 9] > 0
+
+        local = idx - offset
+        in_part = (local >= 0) & (local < size)
+        m = jnp.maximum(size, 1)
+        rank = _mulmod(local - home, step_inv, m)
+
+        healthy = health_ref[:] > 0
+        conc_row = conc_out[pl.ds(slot, 1), :]
+        eligible = in_part & healthy & ((conc_row > 0) | (free_out[:] >= need))
+        key = jnp.where(eligible, rank, big)
+        kmin = jnp.min(key)
+        sel = jnp.min(jnp.where(key == kmin, idx, big))
+        found = kmin < big
+
+        usable = in_part & healthy
+        fkey = jnp.where(usable, jnp.mod(local - rand, m), big)
+        fmin = jnp.min(fkey)
+        fsel = jnp.min(jnp.where(fkey == fmin, idx, big))
+        have_usable = fmin < big
+
+        chosen = jnp.where(found, sel, fsel)
+        placed = valid & (found | have_usable)
+        forced = valid & jnp.logical_not(found) & have_usable
+
+        is_sel = idx == chosen
+        conc_at = jnp.sum(jnp.where(is_sel, conc_row, 0))
+        use_conc = placed & (conc_at > 0)
+        take_mem = placed & jnp.logical_not(use_conc)
+
+        free_out[:] = free_out[:] - jnp.where(
+            is_sel & take_mem, need, 0).astype(jnp.int32)
+        conc_delta = jnp.where(
+            use_conc, -1,
+            jnp.where(take_mem & (max_conc > 1), max_conc - 1, 0))
+        # an out-of-range slot reads the clamped column (like XLA's
+        # dynamic_index_in_dim) but its write is DROPPED (like XLA scatter)
+        conc_out[pl.ds(slot, 1), :] = conc_row + jnp.where(
+            is_sel & slot_ok, conc_delta, 0).astype(jnp.int32)
+
+        at_i = bidx == i
+        chosen_ref[:] = jnp.where(at_i & placed, chosen, chosen_ref[:])
+        forced_ref[:] = jnp.where(at_i & forced, 1, forced_ref[:])
+        return 0
+
+    jax.lax.fori_loop(0, b, body, 0)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def schedule_batch_pallas(state: PlacementState, batch: RequestBatch,
+                          interpret: bool = False
+                          ) -> Tuple[PlacementState, jax.Array, jax.Array]:
+    """Drop-in for schedule_batch, state in transposed ([A, N]) layout."""
+    n = state.free_mb.shape[0]
+    a = state.conc_free.shape[0]
+    b = batch.offset.shape[0]
+    # pl.ds needs an in-range start: clamp the read column (XLA's
+    # dynamic_index_in_dim does the same) and flag OOB slots so their
+    # writes are dropped (XLA scatter semantics)
+    slot_ok = (batch.conc_slot >= 0) & (batch.conc_slot < a)
+    slot = jnp.clip(batch.conc_slot, 0, a - 1)
+    reqs = jnp.stack(
+        [batch.offset, batch.size, batch.home, batch.step_inv, batch.need_mb,
+         slot, batch.max_conc, batch.rand,
+         batch.valid.astype(jnp.int32), slot_ok.astype(jnp.int32)], axis=1)
+    free2 = state.free_mb.reshape(1, n)
+    health2 = state.health.astype(jnp.int32).reshape(1, n)
+
+    chosen, forced, free_o, conc_o = pl.pallas_call(
+        _kernel,
+        out_shape=(jax.ShapeDtypeStruct((1, b), jnp.int32),
+                   jax.ShapeDtypeStruct((1, b), jnp.int32),
+                   jax.ShapeDtypeStruct((1, n), jnp.int32),
+                   jax.ShapeDtypeStruct((a, n), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        input_output_aliases={2: 2, 3: 3},
+        interpret=interpret,
+    )(reqs, health2, free2, state.conc_free)
+
+    new_state = PlacementState(free_o.reshape(n), conc_o, state.health)
+    return new_state, chosen.reshape(b), forced.reshape(b) > 0

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..ops.placement import PlacementState, RequestBatch
+from ..ops.placement import PlacementState, RequestBatch, _mulmod
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "inv") -> Mesh:
@@ -65,7 +65,7 @@ def make_sharded_schedule(mesh: Mesh, axis: str = "inv"):
         local = gidx - offset
         in_part = (local >= 0) & (local < size)
         size_safe = jnp.maximum(size, 1)
-        rank = jnp.mod((local - home) * step_inv, size_safe)
+        rank = _mulmod(local - home, step_inv, size_safe)
 
         conc_col = jax.lax.dynamic_index_in_dim(state.conc_free, slot, axis=1,
                                                 keepdims=False)

@@ -178,8 +178,12 @@ def down(inv: dict) -> None:
 
 
 def status(inv: dict) -> bool:
+    pids = _pids(inv)
+    if not pids:
+        print("no services running (no pid files)")
+        return False
     all_up = True
-    for name, pid in _pids(inv):
+    for name, pid in pids:
         up_ = _alive(pid)
         all_up &= up_
         print(f"{name}: {'up' if up_ else 'DOWN'} (pid {pid})")
@@ -208,24 +212,42 @@ def render_systemd(inv: dict, outdir: str) -> None:
 def render_k8s(inv: dict, outdir: str) -> None:
     import yaml
     os.makedirs(outdir, exist_ok=True)
-    docs = []
+    # controller + invoker share one store: a ReadWriteMany PVC mounted at
+    # /data (the local-up equivalent of pointing every service at one
+    # sqlite path)
+    docs = [{"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+             "metadata": {"name": "ow-shared-db"},
+             "spec": {"accessModes": ["ReadWriteMany"],
+                      "resources": {"requests": {"storage": "1Gi"}}}}]
     ports = {"bus": inv["bus"]["port"], "edge": inv["edge"]["port"]}
     # pods find each other via their Service DNS names, not loopback
     net = {"bus_bind": "0.0.0.0", "bus_host": "ow-bus",
            "controller_bind": "0.0.0.0", "controller_host": "ow-controller{i}"}
+    db_file = os.path.basename(inv["db"])
     for svc in services(inv, python="python3", net=net):
         name = f"ow-{svc['name']}"
+        argv = list(svc["argv"])
+        pod_spec: dict = {}
+        if "--db" in argv:
+            argv[argv.index("--db") + 1] = f"/data/{db_file}"
+            pod_spec["volumes"] = [{"name": "shared-db",
+                                    "persistentVolumeClaim":
+                                        {"claimName": "ow-shared-db"}}]
         container = {"name": name, "image": "openwhisk-tpu:latest",
-                     "command": svc["argv"],
+                     "command": argv,
                      "env": [{"name": k, "value": v}
                              for k, v in _config_env(inv).items()]}
+        if "--db" in argv:
+            container["volumeMounts"] = [{"name": "shared-db",
+                                          "mountPath": "/data"}]
         docs.append({"apiVersion": "apps/v1", "kind": "Deployment",
                      "metadata": {"name": name},
                      "spec": {"replicas": 1,
                               "selector": {"matchLabels": {"app": name}},
                               "template": {
                                   "metadata": {"labels": {"app": name}},
-                                  "spec": {"containers": [container]}}}})
+                                  "spec": {"containers": [container],
+                                           **pod_spec}}}})
         port = ports.get(svc["name"])
         if svc["name"].startswith("controller"):
             port = inv["controllers"]["base_port"] + int(svc["name"][10:])

@@ -94,11 +94,37 @@ def bench_sharded(n_invokers: int, batch: int, iters: int, n_shards: int = 8,
     return _measure(f"{n_shards}-shard", n_invokers, batch, iters, state, step)
 
 
+def bench_pallas(n_invokers: int, batch: int, iters: int, slot_mb: int = 2048,
+                 action_slots: int = 256, seed: int = 7) -> dict:
+    """schedule-only comparison of the pallas kernel vs the XLA scan."""
+    import jax
+
+    from __graft_entry__ import _example_batch
+    from openwhisk_tpu.ops.placement import init_state, schedule_batch
+    from openwhisk_tpu.ops.placement_pallas import (schedule_batch_pallas,
+                                                    to_transposed)
+
+    state = init_state(n_invokers, [slot_mb] * n_invokers,
+                       action_slots=action_slots)
+    req = _example_batch(n_invokers, batch, seed=seed)
+    row = _measure("xla-schedule", n_invokers, batch, iters, state,
+                   lambda s: schedule_batch(s, req)[:2])
+    prow = _measure("pallas-schedule", n_invokers, batch, iters,
+                    to_transposed(state),
+                    lambda s: schedule_batch_pallas(s, req)[:2])
+    row["pallas_placements_per_sec"] = prow["placements_per_sec"]
+    row["pallas_p50_step_ms"] = prow["p50_step_ms"]
+    row["config"] = "pallas-vs-xla"
+    return row
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sharded", action="store_true",
                     help="also run the 8-shard configurations (needs >=8 "
                          "devices, e.g. the virtual CPU mesh)")
+    ap.add_argument("--pallas", action="store_true",
+                    help="also compare the pallas schedule kernel vs XLA")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--sizes", type=int, nargs="*",
@@ -113,6 +139,12 @@ def main() -> None:
                 continue
             print(json.dumps(bench_sharded(n, args.batch, args.iters)),
                   flush=True)
+    if args.pallas:
+        from openwhisk_tpu.ops.placement_pallas import fits_vmem
+        for n in args.sizes:
+            if fits_vmem(n, 256):
+                print(json.dumps(bench_pallas(n, args.batch, args.iters)),
+                      flush=True)
 
 
 if __name__ == "__main__":

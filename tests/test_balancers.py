@@ -431,3 +431,41 @@ class TestReviewRegressions:
             return handled
 
         assert asyncio.run(go()) == 6
+
+
+class TestPallasKernelOption:
+    def test_pallas_kernel_end_to_end(self):
+        """TpuBalancer(kernel='pallas') serves real publishes with the
+        pallas schedule kernel (interpret mode on the CPU backend)."""
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              batch_window=0.005, max_batch=32,
+                              action_slots=256, kernel="pallas")
+            assert bal.kernel == "pallas"
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4, memory_mb=2048)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            actions = [make_action(f"pl{i}", memory=256) for i in range(8)]
+            promises = await asyncio.gather(*[
+                bal.publish(actions[i % 8], make_msg(actions[i % 8], ident, True))
+                for i in range(24)])
+            results = await asyncio.gather(*[asyncio.wait_for(p, 10)
+                                             for p in promises])
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return results
+
+        results = asyncio.run(go())
+        assert len(results) == 24
+        assert all(r.response.is_success for r in results)
+
+    def test_pallas_falls_back_when_state_too_large(self):
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          action_slots=4096, initial_pad=1024,
+                          kernel="pallas")
+        assert bal.kernel == "xla"  # 1024x4096 state exceeds the VMEM budget

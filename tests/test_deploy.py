@@ -62,6 +62,14 @@ class TestInventoryAndRenderers:
         kinds = [d["kind"] for d in docs]
         assert kinds.count("Deployment") == 4  # bus, invoker, controller, edge
         assert "Service" in kinds
+        assert kinds.count("PersistentVolumeClaim") == 1
+        # db-using pods mount the shared store; their --db points into it
+        for nm in ("ow-controller0", "ow-invoker0"):
+            d = next(x for x in docs if x["kind"] == "Deployment"
+                     and x["metadata"]["name"] == nm)
+            c = d["spec"]["template"]["spec"]["containers"][0]
+            assert c["volumeMounts"][0]["mountPath"] == "/data"
+            assert c["command"][c["command"].index("--db") + 1].startswith("/data/")
         ctrl = next(d for d in docs if d["metadata"]["name"] == "ow-controller0"
                     and d["kind"] == "Deployment")
         env = ctrl["spec"]["template"]["spec"]["containers"][0]["env"]

@@ -317,3 +317,68 @@ class TestShardedParity:
                             jnp.asarray(ok))
         np.testing.assert_array_equal(np.asarray(s1r.free_mb),
                                       np.asarray(s2r.free_mb))
+
+
+# ---------------------------------------------------------------------------
+# north-star scale: 64k invokers (BASELINE.json top configuration)
+# ---------------------------------------------------------------------------
+
+class TestNorthStarScale:
+    def test_mulmod_no_int32_overflow(self):
+        """Probe-rank math must survive size * step_inv products past 2**31
+        (naive int32 multiply corrupts ~1/3 of ranks at 64k fleet size)."""
+        from openwhisk_tpu.ops.placement import _mulmod
+        cases = [(65535, 65534), (65536 - 2, 65533), (131072 - 1, 131070),
+                 (46349, 46340), (7, 5)]
+        for m, b in cases:
+            a = np.arange(-m, m, max(1, m // 501), dtype=np.int64)
+            want = (a % m * b) % m
+            got = np.asarray(_mulmod(jnp.asarray(a, jnp.int32),
+                                     jnp.int32(b), jnp.int32(m)),
+                             dtype=np.int64)
+            np.testing.assert_array_equal(got, want, err_msg=f"m={m} b={b}")
+
+    def test_kernel_matches_oracle_at_64k(self):
+        """Sequential-equivalence at the 64k-invoker configuration, with a
+        trace that exercises large step inverses."""
+        n = 65536
+        st = ShardingPolicyState.build([2048] * n)
+        slot_of = _make_slot_allocator()
+        trace = _random_trace(24, 48, seed=64, conc_choices=(1, 4),
+                              mems=(128, 256))
+        batch = _batch_from_trace(st, trace, slot_of)
+        assert int(np.asarray(batch.step_inv).max()) * (n - 1) > 2**31, \
+            "trace does not exercise the overflow regime"
+        kstate = init_state(n, [st.invoker_slot_mb(2048)] * n, action_slots=64)
+        kstate, chosen, forced = schedule_batch(kstate, batch)
+        oracle = _run_oracle(st, trace)
+        for i, ((oc, of), kc, kf) in enumerate(zip(oracle, np.asarray(chosen),
+                                                   np.asarray(forced))):
+            assert (oc, of) == (int(kc), bool(kf)), \
+                f"req {i}: oracle {(oc, of)} vs kernel {(int(kc), bool(kf))}"
+        kernel_free = np.asarray(kstate.free_mb)
+        oracle_free = np.array([inv.semaphore.available_permits
+                                for inv in st.invokers])
+        np.testing.assert_array_equal(kernel_free, oracle_free)
+
+    def test_sharded_8way_matches_single_at_64k(self):
+        """The 8-shard mesh kernel must agree with the single-device kernel
+        at the target fleet size."""
+        from openwhisk_tpu.parallel import (make_mesh, make_sharded_schedule,
+                                            shard_state)
+        n = 65536
+        mesh = make_mesh(8)
+        st = ShardingPolicyState.build([2048] * n)
+        slot_of = _make_slot_allocator()
+        trace = _random_trace(16, 32, seed=65, conc_choices=(1,),
+                              mems=(128, 256, 512))
+        batch = _batch_from_trace(st, trace, slot_of)
+
+        single = init_state(n, [2048] * n, action_slots=32)
+        s1, c1, f1 = schedule_batch(single, batch)
+        sharded = shard_state(init_state(n, [2048] * n, action_slots=32), mesh)
+        s2, c2, f2 = make_sharded_schedule(mesh)(sharded, batch)
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+        np.testing.assert_array_equal(np.asarray(s1.free_mb),
+                                      np.asarray(s2.free_mb))
