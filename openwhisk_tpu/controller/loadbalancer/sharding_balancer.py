@@ -26,9 +26,10 @@ class ShardingBalancer(CommonLoadBalancer):
         self.policy = ShardingPolicyState.build(
             [], cluster_size=cluster_size, managed_fraction=managed_fraction,
             blackbox_fraction=blackbox_fraction)
-        self.supervision = InvokerPool(messaging_provider,
-                                       on_status_change=self._status_change,
-                                       logger=logger)
+        # per-controller group: each controller keeps its own full ping view
+        self.supervision = InvokerPool(
+            messaging_provider, on_status_change=self._status_change,
+            logger=logger, group=f"health-{controller_instance.as_string}")
         self._registry: List[InvokerInstanceId] = []
         self._usable: List[bool] = []
 

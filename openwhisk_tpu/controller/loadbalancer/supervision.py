@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ...core.entity import InvokerInstanceId
-from ...messaging.connector import MessageFeed
+from ...messaging.connector import MessageFeed, HEALTH_RETENTION_BYTES, HEALTH_TOPIC
 from ...messaging.message import PingMessage
 from ...utils.ring_buffer import RingBuffer
 from ...utils.scheduler import Scheduler
@@ -75,8 +75,12 @@ class InvokerPool:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        self.provider.ensure_topic("health")
-        consumer = self.provider.get_consumer("health", self.group, max_peek=128)
+        # pings are ephemeral: tight retention, and never replay a backlog
+        # into a new per-controller group
+        self.provider.ensure_topic(HEALTH_TOPIC,
+                                   retention_bytes=HEALTH_RETENTION_BYTES)
+        consumer = self.provider.get_consumer(HEALTH_TOPIC, self.group,
+                                              max_peek=128, from_latest=True)
         box = {}
 
         async def handle(payload: bytes):

@@ -128,9 +128,12 @@ class TpuBalancer(CommonLoadBalancer):
         self._flush_task: Optional[asyncio.Task] = None
         self._step_lock = asyncio.Lock()
 
-        self.supervision = InvokerPool(messaging_provider,
-                                       on_status_change=self._status_change,
-                                       logger=logger)
+        # group is per-controller: every controller needs its OWN full view
+        # of the ping stream (a shared group would split pings between
+        # controllers; ref: each controller runs its own InvokerPool)
+        self.supervision = InvokerPool(
+            messaging_provider, on_status_change=self._status_change,
+            logger=logger, group=f"health-{controller_instance.as_string}")
         self._recompute_partitions()
 
     # -- device state ------------------------------------------------------

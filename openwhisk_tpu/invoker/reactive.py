@@ -20,14 +20,13 @@ from ..core.entity import (ActivationResponse, EntityName, EntityPath,
                            ExecManifest, InvokerInstanceId, MemoryLimit,
                            WhiskActivation)
 from ..database import EntityStore, NoDocumentException
-from ..messaging.connector import MessageFeed
+from ..messaging.connector import MessageFeed, HEALTH_RETENTION_BYTES, HEALTH_TOPIC
 from ..messaging.message import (ActivationMessage,
                                  CombinedCompletionAndResultMessage,
                                  CompletionMessage, PingMessage, ResultMessage)
 from ..utils.scheduler import Scheduler
 from ..utils.transaction import TransactionId
 
-HEALTH_TOPIC = "health"
 
 
 class InvokerReactive:
@@ -78,7 +77,8 @@ class InvokerReactive:
     async def start(self, start_prewarm: bool = True) -> None:
         topic = self.instance.as_string
         self.provider.ensure_topic(topic)
-        self.provider.ensure_topic(HEALTH_TOPIC)
+        self.provider.ensure_topic(HEALTH_TOPIC,
+                                   retention_bytes=HEALTH_RETENTION_BYTES)
         if start_prewarm:
             await self.pool.start()
         consumer = self.provider.get_consumer(topic, topic, max_peek=self.max_peek())

@@ -53,13 +53,22 @@ class MessagingProvider:
     def get_producer(self) -> MessageProducer:
         raise NotImplementedError
 
-    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128) -> MessageConsumer:
+    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128,
+                     from_latest: bool = False) -> MessageConsumer:
+        """from_latest: start a NEW group at the stream head instead of the
+        retained backlog — for ephemeral streams (health pings) where replay
+        would resurrect stale state."""
         raise NotImplementedError
 
     def ensure_topic(self, topic: str, partitions: int = 1,
                      retention_bytes: Optional[int] = None) -> None:
         raise NotImplementedError
 
+
+#: the invoker ping stream: smallest retention of any topic (ref gives the
+#: health topic its tightest retention) and consumed from_latest
+HEALTH_TOPIC = "health"
+HEALTH_RETENTION_BYTES = 512 * 1024
 
 Handler = Callable[[bytes], Awaitable[None]]
 

@@ -61,13 +61,17 @@ class KafkaProducer(MessageProducer):
 
 
 class KafkaConsumer(MessageConsumer):
-    def __init__(self, bootstrap: str, topic: str, group: str, max_peek: int = 128):
+    def __init__(self, bootstrap: str, topic: str, group: str, max_peek: int = 128,
+                 from_latest: bool = False):
         _require_kafka()
         self.topic = topic
         self.max_peek = max_peek
+        # from_latest: ephemeral streams (health pings) must not replay the
+        # retained backlog when a new per-controller group first appears
         self._consumer = aiokafka.AIOKafkaConsumer(
             topic, bootstrap_servers=bootstrap, group_id=group,
-            enable_auto_commit=False, auto_offset_reset="earliest")
+            enable_auto_commit=False,
+            auto_offset_reset="latest" if from_latest else "earliest")
         self._started = False
 
     async def peek(self, max_messages: int, timeout: float = 0.5
@@ -102,10 +106,43 @@ class KafkaMessagingProvider(MessagingProvider):
     def get_producer(self) -> KafkaProducer:
         return KafkaProducer(self.bootstrap)
 
-    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128
-                     ) -> KafkaConsumer:
-        return KafkaConsumer(self.bootstrap, topic, group_id, max_peek)
+    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128,
+                     from_latest: bool = False) -> KafkaConsumer:
+        return KafkaConsumer(self.bootstrap, topic, group_id, max_peek,
+                             from_latest=from_latest)
 
     def ensure_topic(self, topic: str, partitions: int = 1,
                      retention_bytes: Optional[int] = None) -> None:
-        pass  # auto-create via broker config; admin-client creation optional
+        """Best-effort topic creation with retention.bytes (the reference
+        creates topics with per-topic retention configs,
+        KafkaMessagingProvider.ensureTopic). Falls back to broker
+        auto-create when no admin client is importable or the broker
+        rejects the call — retention is then operator-managed."""
+        from ..utils.tasks import spawn
+        try:
+            from aiokafka.admin import (  # type: ignore[import-not-found]
+                AIOKafkaAdminClient, NewTopic)
+        except ImportError:
+            return
+
+        async def create():
+            admin = AIOKafkaAdminClient(bootstrap_servers=self.bootstrap)
+            await admin.start()
+            try:
+                configs = {}
+                if retention_bytes is not None:
+                    configs["retention.bytes"] = str(retention_bytes)
+                await admin.create_topics([NewTopic(
+                    name=topic, num_partitions=partitions,
+                    replication_factor=1, topic_configs=configs)])
+            except Exception:  # noqa: BLE001 — exists/unsupported: broker wins
+                pass
+            finally:
+                await admin.close()
+
+        try:
+            import asyncio
+            if asyncio.get_event_loop().is_running():
+                spawn(create(), name=f"kafka-ensure-{topic}")
+        except RuntimeError:
+            pass

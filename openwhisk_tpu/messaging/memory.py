@@ -19,17 +19,36 @@ from typing import Dict, List, Optional, Tuple
 from .connector import MessageConsumer, MessageProducer, MessagingProvider
 
 
+#: backstop per-group retention — bounds queues of groups nobody drains
+#: (e.g. a retired controller's health group); drop-oldest like Kafka's
+#: retention. Tight per-topic caps come from ensure_topic(retention_bytes).
+DEFAULT_MAX_MESSAGES = 1_000_000
+
+
 class _Topic:
-    def __init__(self, name: str):
+    def __init__(self, name: str, max_messages: int = DEFAULT_MAX_MESSAGES):
         self.name = name
+        self.max_messages = max_messages
         self.offset = itertools.count()
         self.groups: Dict[str, deque] = {}
         self.cond = asyncio.Condition()
 
     def queue_for(self, group: str) -> deque:
         if group not in self.groups:
-            self.groups[group] = deque()
+            self.groups[group] = deque(maxlen=self.max_messages)
         return self.groups[group]
+
+    def set_max_messages(self, max_messages: int) -> None:
+        if max_messages == self.max_messages:
+            return
+        self.max_messages = max_messages
+        for g, q in list(self.groups.items()):
+            self.groups[g] = deque(q, maxlen=max_messages)
+
+    def set_retention_bytes(self, retention_bytes: int) -> None:
+        """Map a byte budget to a message cap (~128 B/message estimate)."""
+        self.set_max_messages(min(max(retention_bytes // 128, 64),
+                                  DEFAULT_MAX_MESSAGES))
 
 
 class MemoryBus:
@@ -70,14 +89,26 @@ class MemoryProducer(MessageProducer):
 
 
 class MemoryConsumer(MessageConsumer):
-    def __init__(self, bus: MemoryBus, topic: str, group: str, max_peek: int = 128):
+    def __init__(self, bus: MemoryBus, topic: str, group: str, max_peek: int = 128,
+                 from_latest: bool = False):
         self.bus = bus
         self.topic_name = topic
         self.group = group
         self.max_peek = max_peek
         t = self.bus.topic(topic)
-        # adopt messages produced before any subscriber existed
-        if group not in t.groups and "__default__" in t.groups:
+        # adopt messages produced before any subscriber existed — except for
+        # from_latest consumers (ephemeral streams like health pings must
+        # never replay a backlog; Kafka equivalent auto_offset_reset=latest).
+        # Like Kafka's offset reset, from_latest applies only when the group
+        # is NEW — re-attaching to an existing group resumes its backlog.
+        if group in t.groups:
+            pass
+        elif from_latest:
+            t.queue_for(group)  # new group, starts empty
+            # the stream has a live consumer now; pre-subscription retention
+            # is over (nothing should ever replay it)
+            t.groups.pop("__default__", None)
+        elif "__default__" in t.groups:
             t.groups[group] = t.groups.pop("__default__")
         else:
             t.queue_for(group)
@@ -127,9 +158,13 @@ class MemoryMessagingProvider(MessagingProvider):
     def get_producer(self) -> MemoryProducer:
         return MemoryProducer(self.bus)
 
-    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128) -> MemoryConsumer:
-        return MemoryConsumer(self.bus, topic, group_id, max_peek)
+    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128,
+                     from_latest: bool = False) -> MemoryConsumer:
+        return MemoryConsumer(self.bus, topic, group_id, max_peek,
+                              from_latest=from_latest)
 
     def ensure_topic(self, topic: str, partitions: int = 1,
                      retention_bytes: Optional[int] = None) -> None:
-        self.bus.topic(topic)
+        t = self.bus.topic(topic)
+        if retention_bytes is not None:
+            t.set_retention_bytes(retention_bytes)

@@ -102,8 +102,9 @@ class TcpBusServer:
                     key = (req["topic"], req.get("group", "default"))
                     consumer = consumers.get(key)
                     if consumer is None:
-                        consumer = MemoryConsumer(self.bus, key[0], key[1],
-                                                  max_peek=1024)
+                        consumer = MemoryConsumer(
+                            self.bus, key[0], key[1], max_peek=1024,
+                            from_latest=bool(req.get("latest")))
                         consumers[key] = consumer
                     batch = await consumer.peek(int(req.get("max", 128)),
                                                 float(req.get("timeout", 0.5)))
@@ -112,7 +113,9 @@ class TcpBusServer:
                         [off, base64.b64encode(p).decode()]
                         for (_t, _p, off, p) in batch]}))
                 elif op == "ensure":
-                    self.bus.topic(req["topic"])
+                    t = self.bus.topic(req["topic"])
+                    if req.get("retention_bytes") is not None:
+                        t.set_retention_bytes(int(req["retention_bytes"]))
                     writer.write(_frame({"ok": True}))
                 else:
                     writer.write(_frame({"error": f"unknown op {op!r}"}))
@@ -187,17 +190,19 @@ class TcpProducer(MessageProducer):
 
 class TcpConsumer(MessageConsumer):
     def __init__(self, host: str, port: int, topic: str, group: str,
-                 max_peek: int = 128):
+                 max_peek: int = 128, from_latest: bool = False):
         self._conn = _TcpConnection(host, port)
         self.topic = topic
         self.group = group
         self.max_peek = max_peek
+        self.from_latest = from_latest
 
     async def peek(self, max_messages: int, timeout: float = 0.5
                    ) -> List[Tuple[str, int, int, bytes]]:
         try:
             resp = await self._conn.request({
                 "op": "peek", "topic": self.topic, "group": self.group,
+                "latest": self.from_latest,
                 "max": min(max_messages, self.max_peek), "timeout": timeout})
         except ConnectionError:
             await asyncio.sleep(timeout)
@@ -221,9 +226,10 @@ class TcpMessagingProvider(MessagingProvider):
     def get_producer(self) -> TcpProducer:
         return TcpProducer(self.host, self.port)
 
-    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128
-                     ) -> TcpConsumer:
-        return TcpConsumer(self.host, self.port, topic, group_id, max_peek)
+    def get_consumer(self, topic: str, group_id: str, max_peek: int = 128,
+                     from_latest: bool = False) -> TcpConsumer:
+        return TcpConsumer(self.host, self.port, topic, group_id, max_peek,
+                           from_latest=from_latest)
 
     def ensure_topic(self, topic: str, partitions: int = 1,
                      retention_bytes: Optional[int] = None) -> None:
@@ -232,7 +238,8 @@ class TcpMessagingProvider(MessagingProvider):
         try:
             loop = asyncio.get_event_loop()
             if loop.is_running():
-                spawn(self._admin.request({"op": "ensure", "topic": topic}),
+                spawn(self._admin.request({"op": "ensure", "topic": topic,
+                                           "retention_bytes": retention_bytes}),
                       name=f"ensure-{topic}")
         except RuntimeError:
             pass

@@ -1,0 +1,234 @@
+"""Chaos / HA tests: component kill + recovery under a live deployment.
+
+Parity with the reference's ha/ShootComponentsTests (docker-restart
+controller mid-traffic, assert availability via the hot standby),
+invokerShoot/ShootInvokerTests (invoker kill/recovery) and
+limits/ThrottleTests (throttle enforcement over HTTP) — here against real
+OS processes wired over the TCP bus, traffic through the edge proxy.
+"""
+import asyncio
+import base64
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import aiohttp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID  # noqa: E402
+
+AUTH = "Basic " + base64.b64encode(f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+CODE = "def main(a):\n    return {'alive': True, 'n': a.get('n')}\n"
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Cluster:
+    """Popen-based mini-deployment with per-service kill/restart."""
+
+    def __init__(self, tmp_path, n_controllers=1, edge=False, ctrl_env=None):
+        self.db = str(tmp_path / "whisks.db")
+        self.bus_port = _free_port()
+        self.ctrl_ports = [_free_port() for _ in range(n_controllers)]
+        self.edge_port = _free_port() if edge else None
+        self.env = dict(os.environ, PYTHONPATH=REPO, **(ctrl_env or {}))
+        self.procs = {}
+
+    def spawn(self, name, argv):
+        self.procs[name] = subprocess.Popen(
+            argv, env=self.env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def start(self):
+        self.spawn("bus", [sys.executable, "-m", "openwhisk_tpu.messaging",
+                           "--port", str(self.bus_port)])
+        time.sleep(1.5)
+        self.start_invoker()
+        for i, port in enumerate(self.ctrl_ports):
+            argv = [sys.executable, "-m", "openwhisk_tpu.controller",
+                    "--bus", f"127.0.0.1:{self.bus_port}", "--db", self.db,
+                    "--port", str(port), "--instance", str(i),
+                    "--cluster-size", str(len(self.ctrl_ports)),
+                    "--balancer", "sharding"]
+            if i == 0:
+                argv.append("--seed-guest")
+            self.spawn(f"controller{i}", argv)
+        if self.edge_port:
+            self.spawn("edge", [sys.executable, "-m", "openwhisk_tpu.edge",
+                                "--port", str(self.edge_port), "--controllers",
+                                *[f"http://127.0.0.1:{p}"
+                                  for p in self.ctrl_ports]])
+
+    def start_invoker(self, name="chaos-a"):
+        self.spawn("invoker", [sys.executable, "-m", "openwhisk_tpu.invoker",
+                               "--bus", f"127.0.0.1:{self.bus_port}",
+                               "--db", self.db, "--unique-name", name,
+                               "--memory", "1024"])
+
+    def kill(self, name, sig=signal.SIGKILL):
+        proc = self.procs[name]
+        proc.send_signal(sig)
+        proc.wait(timeout=10)
+
+    def stop(self):
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def api(self, port=None):
+        port = port or (self.edge_port or self.ctrl_ports[0])
+        return f"http://127.0.0.1:{port}/api/v1"
+
+    async def wait_healthy(self, session, port=None, want="up", timeout=60):
+        url = f"http://127.0.0.1:{port or self.ctrl_ports[0]}/invokers"
+        for _ in range(timeout * 2):
+            try:
+                async with session.get(url, headers=HDRS) as r:
+                    if r.status == 200 and want in (await r.text()):
+                        return True
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.5)
+        return False
+
+
+@pytest.mark.slow
+class TestControllerFailover:
+    def test_kill_controller0_traffic_survives_via_edge(self, tmp_path):
+        """ref ha/ShootComponentsTests:47-160 — one controller dies, the
+        edge fails over and requests keep succeeding."""
+        cluster = Cluster(tmp_path, n_controllers=2, edge=True)
+        cluster.start()
+        try:
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    assert await cluster.wait_healthy(s)
+                    # both controllers must see the fleet (per-controller
+                    # health groups) before traffic starts
+                    assert await cluster.wait_healthy(
+                        s, port=cluster.ctrl_ports[1])
+                    base = cluster.api()  # through the edge
+                    async with s.put(f"{base}/namespaces/_/actions/ha",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": CODE}}) as r:
+                        assert r.status == 200, await r.text()
+
+                    async def invoke(n):
+                        async with s.post(
+                                f"{base}/namespaces/_/actions/ha?blocking=true&result=true",
+                                headers=HDRS, json={"n": n}) as r:
+                            return r.status, await r.json()
+
+                    assert (await invoke(1))[0] == 200
+                    cluster.kill("controller0")
+                    # edge marks the dead upstream failed and retries the
+                    # standby; allow the window where in-flight errors once
+                    ok = 0
+                    for n in range(12):
+                        status, body = await invoke(100 + n)
+                        if status == 200 and body == {"alive": True,
+                                                      "n": 100 + n}:
+                            ok += 1
+                        await asyncio.sleep(0.25)
+                    return ok
+
+            ok = asyncio.run(drive())
+            assert ok >= 8, f"only {ok}/12 invokes survived controller kill"
+        finally:
+            cluster.stop()
+
+
+@pytest.mark.slow
+class TestInvokerRecovery:
+    def test_invoker_kill_marks_down_then_recovers(self, tmp_path):
+        """ref invokerShoot/ShootInvokerTests — ping silence flips the
+        invoker Offline (10 s); a restart under the same unique name reuses
+        the id and serves traffic again."""
+        cluster = Cluster(tmp_path, n_controllers=1)
+        cluster.start()
+        try:
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    assert await cluster.wait_healthy(s)
+                    base = cluster.api()
+                    async with s.put(f"{base}/namespaces/_/actions/rec",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": CODE}}) as r:
+                        assert r.status == 200
+
+                    cluster.kill("invoker")
+                    # offline after 10 s of silence
+                    assert await cluster.wait_healthy(s, want="down",
+                                                      timeout=30), \
+                        "invoker never marked down"
+                    # invoking now is rejected (no usable invokers)
+                    async with s.post(
+                            f"{base}/namespaces/_/actions/rec?blocking=true",
+                            headers=HDRS, json={}) as r:
+                        rejected = r.status
+
+                    cluster.start_invoker(name="chaos-a")  # same unique name
+                    assert await cluster.wait_healthy(s, want="up",
+                                                      timeout=60)
+                    async with s.post(
+                            f"{base}/namespaces/_/actions/rec?blocking=true&result=true",
+                            headers=HDRS, json={"n": 7}) as r:
+                        return rejected, r.status, await r.json()
+
+            rejected, status, body = asyncio.run(drive())
+            assert rejected >= 500  # unavailable while fleet is down
+            assert (status, body) == (200, {"alive": True, "n": 7})
+        finally:
+            cluster.stop()
+
+
+@pytest.mark.slow
+class TestThrottlesOverHttp:
+    def test_rate_throttle_returns_429(self, tmp_path):
+        """ref limits/ThrottleTests — invocations past the per-minute rate
+        limit are rejected with 429 over the REST surface."""
+        cluster = Cluster(tmp_path, n_controllers=1,
+                          ctrl_env={"CONFIG_whisk_limits_invocationsPerMinute": "2"})
+        cluster.start()
+        try:
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    assert await cluster.wait_healthy(s)
+                    base = cluster.api()
+                    async with s.put(f"{base}/namespaces/_/actions/th",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": CODE}}) as r:
+                        assert r.status == 200
+                    statuses = []
+                    for _ in range(4):
+                        async with s.post(
+                                f"{base}/namespaces/_/actions/th?blocking=true",
+                                headers=HDRS, json={}) as r:
+                            statuses.append(r.status)
+                            body = await r.json()
+                    return statuses, body
+
+            statuses, last_body = asyncio.run(drive())
+            assert statuses[:2] == [200, 200]
+            assert 429 in statuses[2:], statuses
+            assert "error" in last_body
+        finally:
+            cluster.stop()

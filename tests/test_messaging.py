@@ -184,3 +184,87 @@ class TestMessageFeed:
             return good
 
         assert asyncio.run(run()) == [b"ok"]
+
+
+class TestRetentionAndFromLatest:
+    def test_orphan_group_queue_is_bounded(self):
+        """A group nobody drains (retired controller) must not grow without
+        bound: retention drops oldest, like Kafka."""
+        async def go():
+            from openwhisk_tpu.messaging.memory import MemoryMessagingProvider
+            provider = MemoryMessagingProvider()
+            provider.ensure_topic("health", retention_bytes=128 * 100)  # cap 100
+            orphan = provider.get_consumer("health", "health-controller9")
+            producer = provider.get_producer()
+            for i in range(500):
+                await producer.send("health", f"ping{i}".encode())
+            q = provider.bus.topic("health").groups["health-controller9"]
+            assert len(q) == 100
+            # oldest dropped, newest retained
+            batch = await orphan.peek(1000, timeout=0.1)
+            return [p.decode() for (_t, _p, _o, p) in batch]
+
+        msgs = asyncio.run(go())
+        assert msgs[0] == "ping400" and msgs[-1] == "ping499"
+
+    def test_from_latest_group_skips_backlog(self):
+        """A new from_latest group (per-controller health view) starts at the
+        stream head: no replay of retained pings."""
+        async def go():
+            from openwhisk_tpu.messaging.memory import MemoryMessagingProvider
+            provider = MemoryMessagingProvider()
+            producer = provider.get_producer()
+            for i in range(50):
+                await producer.send("health", f"stale{i}".encode())
+            fresh = provider.get_consumer("health", "health-controller1",
+                                          from_latest=True)
+            await producer.send("health", b"live")
+            batch = await fresh.peek(100, timeout=0.2)
+            return [p for (_t, _p, _o, p) in batch]
+
+        assert asyncio.run(go()) == [b"live"]
+
+    def test_from_latest_over_tcp_bus(self):
+        async def go():
+            from openwhisk_tpu.messaging.tcp import (TcpBusServer,
+                                                     TcpMessagingProvider)
+            server = TcpBusServer("127.0.0.1", 0)
+            await server.start()
+            port = server._server.sockets[0].getsockname()[1]
+            provider = TcpMessagingProvider("127.0.0.1", port)
+            producer = provider.get_producer()
+            for i in range(20):
+                await producer.send("health", f"stale{i}".encode())
+            fresh = provider.get_consumer("health", "health-c1",
+                                          from_latest=True)
+            # first peek creates the latest-positioned group server-side
+            first = await fresh.peek(100, timeout=0.2)
+            await producer.send("health", b"live")
+            second = await fresh.peek(100, timeout=1.0)
+            await fresh.close()
+            await producer.close()
+            await server.stop()
+            return first, [p for (_t, _pp, _o, p) in second]
+
+        first, second = asyncio.run(go())
+        assert first == []
+        assert second == [b"live"]
+
+    def test_from_latest_reattach_resumes_backlog(self):
+        """from_latest applies only to a NEW group (Kafka offset-reset
+        semantics): re-attaching — e.g. after a TCP blip recreates the
+        server-side consumer — must resume the buffered backlog, not drop
+        it."""
+        async def go():
+            from openwhisk_tpu.messaging.memory import MemoryMessagingProvider
+            provider = MemoryMessagingProvider()
+            producer = provider.get_producer()
+            c1 = provider.get_consumer("health", "health-c0", from_latest=True)
+            await producer.send("health", b"p1")
+            await producer.send("health", b"p2")
+            # reconnect: same group, new consumer object
+            c2 = provider.get_consumer("health", "health-c0", from_latest=True)
+            batch = await c2.peek(10, timeout=0.2)
+            return [p for (_t, _pp, _o, p) in batch]
+
+        assert asyncio.run(go()) == [b"p1", b"p2"]
