@@ -35,6 +35,32 @@ def _compile_action(code: str, main: str):
     return fn
 
 
+def _compile_binary_action(b64_zip: str, main: str):
+    """Binary action: base64 zip with __main__.py, like the reference's
+    python runtime (the zip may carry a package tree; it is extracted and
+    put on sys.path so imports inside it resolve)."""
+    import base64
+    import tempfile
+    import zipfile
+
+    workdir = tempfile.mkdtemp(prefix="ow-action-")
+    zip_path = os.path.join(workdir, "action.zip")
+    with open(zip_path, "wb") as f:
+        f.write(base64.b64decode(b64_zip))
+    with zipfile.ZipFile(zip_path) as z:
+        for member in z.namelist():  # refuse path traversal
+            target = os.path.realpath(os.path.join(workdir, member))
+            if not target.startswith(os.path.realpath(workdir) + os.sep):
+                raise ValueError("zip entry escapes the action directory")
+        z.extractall(workdir)
+    entry = os.path.join(workdir, "__main__.py")
+    if not os.path.exists(entry):
+        raise ValueError("Initialization has failed: zip has no __main__.py")
+    sys.path.insert(0, workdir)
+    with open(entry) as f:
+        return _compile_action(f.read(), main)
+
+
 class Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
@@ -72,11 +98,11 @@ class Handler(BaseHTTPRequestHandler):
         value = payload.get("value", {})
         code = value.get("code", "")
         main = value.get("main") or "main"
-        if value.get("binary"):
-            self._reply(502, {"error": "binary python actions are not supported by this proxy"})
-            return
         try:
-            _state["fn"] = _compile_action(code, main)
+            if value.get("binary"):
+                _state["fn"] = _compile_binary_action(code, main)
+            else:
+                _state["fn"] = _compile_action(code, main)
             _state["env"] = value.get("env") or {}
             # export the init environment (e.g. __OW_API_KEY) so user code
             # can read it via os.environ, as in the real runtime images

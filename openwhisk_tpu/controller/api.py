@@ -539,6 +539,12 @@ class ControllerApi:
             await self._check(request, PUT, ns)
             body = await request.json()
             apidoc = body.get("apidoc", body)
+            # resolve the "_" namespace placeholder inside the apidoc the
+            # same way the URL path resolves it, else the stored backend
+            # URL would point at the literal "_" namespace and 404
+            target = apidoc.get("action")
+            if isinstance(target, dict) and target.get("namespace") in ("_", None):
+                target["namespace"] = ns
             try:
                 view = await rm.create_api(ns, apidoc)
             except ApiManagementException as e:

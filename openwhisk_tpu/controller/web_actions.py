@@ -62,6 +62,23 @@ class WebActionsApi:
         if web_flag is not True:
             return web.json_response(
                 {"error": "The requested resource does not exist."}, status=404)
+        # require-whisk-auth (ref WebActions.scala): a secret-valued
+        # annotation demands the matching X-Require-Whisk-Auth header; the
+        # boolean `true` demands valid platform credentials instead
+        required = action.annotations.get("require-whisk-auth")
+        denied = web.json_response(
+            {"error": "Authentication is possible but has failed or not "
+                      "yet been provided."}, status=401)
+        if required is True:
+            ident = await self.c.authenticator.identity_from_header(
+                request.headers.get("Authorization"))
+            if ident is None:
+                return denied
+        elif required is not None and required is not False:
+            # identity tests, not equality: the secret 0 must NOT be treated
+            # as the boolean False (0 == False in Python)
+            if request.headers.get("X-Require-Whisk-Auth") != str(required):
+                return denied
         raw_http = action.annotations.get("raw-http") is True
 
         payload = await self._context_payload(request, raw_http)

@@ -66,7 +66,13 @@ async def run(args) -> int:
     ns = "_"
 
     def show(status, data):
-        print(json.dumps(data, indent=2))
+        try:
+            print(json.dumps(data, indent=2))
+        except BrokenPipeError:  # downstream pager/head closed the pipe
+            try:
+                sys.stdout.close()
+            except OSError:
+                pass
         return 0 if status < 400 else 1
 
     e = args.entity
@@ -153,6 +159,46 @@ async def run(args) -> int:
             path = f"/namespaces/{ns}/packages" + \
                 ("" if args.cmd == "list" else f"/{args.name}")
             return show(*await client.request(method, path))
+    elif e == "api":
+        # reference: wsk api create BASE_PATH API_PATH VERB ACTION — here the
+        # positional slots map to name=basepath, artifact=relpath, with verb
+        # and action from flags (ref core/routemgmt createApi)
+        if args.cmd == "create":
+            if not (args.name and args.artifact and args.verb and args.action):
+                print("usage: wsk api create <basepath> <relpath> "
+                      "--verb get --action <web-action>", file=sys.stderr)
+                return 2
+            apidoc = {"gatewayBasePath": args.name,
+                      "gatewayPath": args.artifact,
+                      "gatewayMethod": args.verb,
+                      "action": {"name": args.action, "namespace": ns},
+                      "responsetype": args.response_type}
+            if args.apiname:
+                apidoc["apiName"] = args.apiname
+            return show(*await client.request(
+                "POST", f"/namespaces/{ns}/apis", {"apidoc": apidoc}))
+        if args.cmd in ("get", "list"):
+            params = {}
+            if args.name:
+                params["basepath"] = args.name
+            if args.artifact:
+                params["relpath"] = args.artifact
+            if args.verb:
+                params["operation"] = args.verb
+            return show(*await client.request(
+                "GET", f"/namespaces/{ns}/apis", params=params))
+        if args.cmd == "delete":
+            if not args.name:
+                print("usage: wsk api delete <basepath> [relpath] [--verb v]",
+                      file=sys.stderr)
+                return 2
+            params = {"basepath": args.name}
+            if args.artifact:
+                params["relpath"] = args.artifact
+            if args.verb:
+                params["operation"] = args.verb
+            return show(*await client.request(
+                "DELETE", f"/namespaces/{ns}/apis", params=params))
     print("unknown command", file=sys.stderr)
     return 2
 
@@ -162,7 +208,7 @@ def main(argv=None) -> int:
     parser.add_argument("--apihost", default=None)
     parser.add_argument("--auth", "-u", default=None)
     parser.add_argument("entity", choices=("action", "activation", "trigger",
-                                           "rule", "package"))
+                                           "rule", "package", "api"))
     parser.add_argument("cmd")
     parser.add_argument("name", nargs="?")
     parser.add_argument("artifact", nargs="?")
@@ -177,7 +223,13 @@ def main(argv=None) -> int:
     parser.add_argument("--result", "-r", action="store_true")
     parser.add_argument("--limit", "-l", type=int, default=30)
     parser.add_argument("--trigger", default=None, help="rule create: trigger name")
-    parser.add_argument("--action", default=None, help="rule create: action name")
+    parser.add_argument("--action", default=None,
+                        help="rule/api create: target action name")
+    parser.add_argument("--verb", default=None,
+                        help="api: HTTP verb (get/post/...)")
+    parser.add_argument("--apiname", default=None, help="api create: API name")
+    parser.add_argument("--response-type", default="json",
+                        help="api create: json|http|text|html|svg")
     args = parser.parse_args(argv)
     return asyncio.run(run(args))
 

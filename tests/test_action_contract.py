@@ -1,0 +1,161 @@
+"""Runtime-contract tests: the /init + /run HTTP contract of action
+sandboxes, driven directly against the action proxy as a real subprocess —
+the reference's tests/.../actionContainers suite (ActionProxyContainerTests,
+PythonActionContainerTests) for this framework's runtime image equivalent.
+"""
+import base64
+import io
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import zipfile
+
+import aiohttp
+import asyncio
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROXY = os.path.join(REPO, "openwhisk_tpu", "containerpool", "actionproxy.py")
+SENTINEL = "XXX_THE_END_OF_A_WHISK_ACTIVATION_XXX"
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def proxy():
+    port = _free_port()
+    proc = subprocess.Popen([sys.executable, "-u", PROXY, str(port)],
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with socket.socket() as s:
+            s.settimeout(0.5)
+            try:
+                s.connect(("127.0.0.1", port))
+                break
+            except OSError:
+                time.sleep(0.1)
+    else:
+        proc.kill()
+        raise AssertionError("proxy never started")
+    yield f"http://127.0.0.1:{port}", proc
+    proc.kill()
+    proc.wait(timeout=5)
+
+
+def _post(base, path, payload):
+    async def go():
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + path, json=payload) as r:
+                return r.status, await r.json(content_type=None)
+    return asyncio.run(go())
+
+
+class TestInitRunContract:
+    def test_init_then_run(self, proxy):
+        base, _ = proxy
+        st, body = _post(base, "/init", {"value": {
+            "code": "def main(args):\n    return {'echo': args.get('x')}\n"}})
+        assert (st, body) == (200, {"ok": True})
+        st, body = _post(base, "/run", {"value": {"x": 42}})
+        assert (st, body) == (200, {"echo": 42})
+
+    def test_run_before_init_fails(self, proxy):
+        base, _ = proxy
+        st, body = _post(base, "/run", {"value": {}})
+        assert st == 502 and "uninitialized" in body["error"]
+
+    def test_init_broken_code_reports_error(self, proxy):
+        base, _ = proxy
+        st, body = _post(base, "/init", {"value": {"code": "def main(:\n"}})
+        assert st == 502 and "Initialization has failed" in body["error"]
+
+    def test_custom_main(self, proxy):
+        base, _ = proxy
+        st, _ = _post(base, "/init", {"value": {
+            "code": "def other(args):\n    return {'via': 'other'}\n",
+            "main": "other"}})
+        assert st == 200
+        st, body = _post(base, "/run", {"value": {}})
+        assert body == {"via": "other"}
+
+    def test_env_and_activation_context(self, proxy):
+        base, _ = proxy
+        code = ("import os\n"
+                "def main(args):\n"
+                "    return {'key': os.environ.get('SECRET'),\n"
+                "            'ns': os.environ.get('__OW_NAMESPACE')}\n")
+        st, _ = _post(base, "/init",
+                      {"value": {"code": code, "env": {"SECRET": "s3cr3t"}}})
+        assert st == 200
+        st, body = _post(base, "/run", {"value": {}, "namespace": "guest"})
+        assert body == {"key": "s3cr3t", "ns": "guest"}
+
+    def test_log_sentinel_framing(self, proxy):
+        base, proc = proxy
+        _post(base, "/init", {"value": {
+            "code": "def main(args):\n    print('hello log')\n    return {}\n"}})
+        _post(base, "/run", {"value": {}})
+        time.sleep(0.3)
+        proc.kill()
+        out = proc.stdout.read().decode()
+        assert "hello log" in out
+        assert out.count(SENTINEL) >= 1
+        assert out.index("hello log") < out.index(SENTINEL)
+
+    def test_non_dict_result_is_error(self, proxy):
+        base, _ = proxy
+        _post(base, "/init", {"value": {
+            "code": "def main(args):\n    return 'not a dict'\n"}})
+        st, body = _post(base, "/run", {"value": {}})
+        assert st == 502
+        assert "error" in body
+
+
+class TestBinaryActions:
+    def _zip_b64(self, files: dict) -> str:
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            for name, content in files.items():
+                z.writestr(name, content)
+        return base64.b64encode(buf.getvalue()).decode()
+
+    def test_binary_zip_with_package(self, proxy):
+        base, _ = proxy
+        code = self._zip_b64({
+            "__main__.py": "from helpers.lib import greet\n"
+                           "def main(args):\n"
+                           "    return {'msg': greet(args.get('who', 'zip'))}\n",
+            "helpers/__init__.py": "",
+            "helpers/lib.py": "def greet(w):\n    return 'hi ' + w\n",
+        })
+        st, body = _post(base, "/init",
+                         {"value": {"code": code, "binary": True}})
+        assert (st, body) == (200, {"ok": True}), body
+        st, body = _post(base, "/run", {"value": {"who": "pkg"}})
+        assert (st, body) == (200, {"msg": "hi pkg"})
+
+    def test_binary_zip_without_main_fails(self, proxy):
+        base, _ = proxy
+        code = self._zip_b64({"other.py": "x = 1\n"})
+        st, body = _post(base, "/init",
+                         {"value": {"code": code, "binary": True}})
+        assert st == 502 and "__main__.py" in body["error"]
+
+    def test_zip_path_traversal_rejected(self, proxy):
+        base, _ = proxy
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            z.writestr("../evil.py", "x = 1")
+            z.writestr("__main__.py", "def main(a):\n    return {}\n")
+        code = base64.b64encode(buf.getvalue()).decode()
+        st, body = _post(base, "/init",
+                         {"value": {"code": code, "binary": True}})
+        assert st == 502 and "escapes" in body["error"]
