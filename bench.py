@@ -1,9 +1,11 @@
 """Benchmark: activation placement decisions/sec on the TPU placement kernel.
 
-Measures the steady-state rate of the balancer's device step — a micro-batch
-of B=256 placements (schedule_batch) followed by the matching release fold
-(release_batch), over a 1024-invoker fleet — i.e. the full device work per
-scheduled activation, books held constant so the loop runs indefinitely.
+Measures the steady-state rate of the balancer's device step — ONE fused
+program (ops.placement.make_fused_step: previous batch's release fold +
+health fold + a B=256 schedule) over a 1024-invoker fleet, exactly the
+program TpuBalancer._device_step dispatches per micro-batch. Books are held
+constant (each step releases the prior step's placements) so the loop runs
+indefinitely.
 
 Baseline: BASELINE.json targets >= 50,000 placements/s (reference point: the
 CPU ShardingContainerPoolBalancer inner loop, which this kernel replaces).
@@ -29,31 +31,39 @@ TARGET = 50_000.0
 
 def main() -> None:
     import jax
+    import jax.numpy as jnp
 
     from __graft_entry__ import _example_batch
-    from openwhisk_tpu.ops.placement import (init_state, release_batch,
-                                             schedule_batch)
+    from openwhisk_tpu.ops.placement import init_state, make_fused_step
 
     state0 = init_state(N_INVOKERS, [2048] * N_INVOKERS, action_slots=256)
     batch = _example_batch(N_INVOKERS, BATCH, seed=7)
 
-    def step(state):
-        state, chosen, forced = schedule_batch(state, batch)
-        ok = chosen >= 0
-        state = release_batch(state, jax.numpy.clip(chosen, 0), batch.conc_slot,
-                              batch.need_mb, batch.max_conc, ok)
-        return state, chosen
+    # the balancer's actual device program: fold releases + health flips +
+    # schedule, compiled as ONE call (ops.placement.make_fused_step). The
+    # releases fed in are the previous batch's placements, books constant.
+    fused = make_fused_step()
+    hidx = jnp.zeros((8,), jnp.int32)
+    hval = jnp.zeros((8,), bool)
+    hmask = jnp.zeros((8,), bool)
 
-    state = state0
+    def step(carry):
+        state, rel_inv, rel_ok = carry
+        state, chosen, forced = fused(
+            state, rel_inv, batch.conc_slot, batch.need_mb, batch.max_conc,
+            rel_ok, hidx, hval, hmask, batch)
+        return (state, jnp.clip(chosen, 0), chosen >= 0), chosen
+
+    carry = (state0, jnp.zeros((BATCH,), jnp.int32), jnp.zeros((BATCH,), bool))
     for _ in range(WARMUP):
-        state, chosen = step(state)
-    jax.block_until_ready(state)
+        carry, chosen = step(carry)
+    jax.block_until_ready(carry)
 
     lat = []
     t0 = time.perf_counter()
     for _ in range(ITERS):
         t1 = time.perf_counter()
-        state, chosen = step(state)
+        carry, chosen = step(carry)
         jax.block_until_ready(chosen)
         lat.append(time.perf_counter() - t1)
     dt = time.perf_counter() - t0

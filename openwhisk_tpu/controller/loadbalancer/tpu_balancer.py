@@ -45,7 +45,8 @@ from ...messaging.message import ActivationMessage
 from ...models.sharding_policy import (MIN_SLOT_MB, generate_hash,
                                        pairwise_coprimes)
 from ...ops.placement import (PlacementState, RequestBatch, init_state,
-                              release_batch, schedule_batch)
+                              make_fused_step, release_batch, schedule_batch,
+                              set_health)
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException)
 from .supervision import InvokerPool
@@ -176,6 +177,9 @@ class TpuBalancer(CommonLoadBalancer):
             self.state = state
             self._sched_fn = schedule_batch
             self._release_fn = release_batch
+        # release + health-fold + schedule as ONE compiled program (vs three
+        # dispatches per micro-batch)
+        self._fused_fn = make_fused_step(self._release_fn, self._sched_fn)
 
     def _pallas_fits(self) -> bool:
         from ...ops.placement_pallas import fits_vmem
@@ -240,6 +244,7 @@ class TpuBalancer(CommonLoadBalancer):
             # grown past the VMEM budget: swap in the XLA kernel
             self._sched_fn = schedule_batch
             self._release_fn = release_batch
+            self._fused_fn = make_fused_step(self._release_fn, self._sched_fn)
 
     def _recompute_partitions(self) -> None:
         n = len(self._registry)
@@ -397,6 +402,12 @@ class TpuBalancer(CommonLoadBalancer):
                 return
             delay = self.batch_window
 
+    #: health updates drained per device step — a FIXED batch shape, so the
+    #: fused program's compile-cache keys vary only in (release, batch)
+    #: buckets; leftovers roll to the next step (fleet churn is slow vs the
+    #: step rate)
+    HEALTH_BATCH = 64
+
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
         """Pad batch sizes to power-of-two buckets so the jitted kernels see
@@ -406,32 +417,57 @@ class TpuBalancer(CommonLoadBalancer):
             b *= 2
         return min(b, cap) if n <= cap else cap
 
+    def _release_arrays(self):
+        """Drain buffered releases into padded device arrays (+ host-side
+        slot bookkeeping)."""
+        cap = self.max_batch * 4
+        rel, self._releases = self._releases[:cap], self._releases[cap:]
+        b = self._bucket(len(rel), cap) if rel else 8
+        pad = b - len(rel)
+        arrays = (
+            jnp.asarray([r[0] for r in rel] + [0] * pad, jnp.int32),
+            jnp.asarray([r[1] for r in rel] + [0] * pad, jnp.int32),
+            jnp.asarray([r[2] for r in rel] + [0] * pad, jnp.int32),
+            jnp.asarray([r[3] for r in rel] + [1] * pad, jnp.int32),
+            jnp.asarray([True] * len(rel) + [False] * pad, bool))
+        for r in rel:
+            self._slots.release(r[4])
+        return arrays
+
+    def _health_arrays(self):
+        """Drain up to HEALTH_BATCH buffered flips into fixed-shape arrays;
+        the remainder stays buffered for the next step."""
+        b = self.HEALTH_BATCH
+        take = list(self._health_updates.items())[:b]
+        for k, _ in take:
+            del self._health_updates[k]
+        pad = b - len(take)
+        if take:
+            # pad by REPEATING the last real entry: duplicate scatter indices
+            # are only deterministic when they write identical values (a
+            # masked "keep current" pad at index 0 would race a real update
+            # of invoker 0)
+            idxs = [k for k, _ in take]
+            vals = [v for _, v in take]
+            return (jnp.asarray(idxs + [idxs[-1]] * pad, jnp.int32),
+                    jnp.asarray(vals + [vals[-1]] * pad, bool),
+                    jnp.asarray([True] * b, bool))
+        return (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+                jnp.zeros((b,), bool))
+
     async def _device_step(self) -> None:
-        # 1. fold buffered releases
-        if self._releases:
-            cap = self.max_batch * 4
-            rel, self._releases = self._releases[:cap], self._releases[cap:]
-            b = self._bucket(len(rel), cap)
-            pad = b - len(rel)
-            inv = jnp.asarray([r[0] for r in rel] + [0] * pad, jnp.int32)
-            slot = jnp.asarray([r[1] for r in rel] + [0] * pad, jnp.int32)
-            mem = jnp.asarray([r[2] for r in rel] + [0] * pad, jnp.int32)
-            maxc = jnp.asarray([r[3] for r in rel] + [1] * pad, jnp.int32)
-            valid = jnp.asarray([True] * len(rel) + [False] * pad, bool)
-            self.state = self._release_fn(self.state, inv, slot, mem, maxc, valid)
-            for r in rel:
-                self._slots.release(r[4])
-        # 2. fold health flips
-        if self._health_updates:
-            ups = self._health_updates
-            self._health_updates = {}
-            idx = jnp.asarray(list(ups.keys()), jnp.int32)
-            val = jnp.asarray(list(ups.values()), bool)
-            health = self.state.health.at[idx].set(val)
-            self.state = self.state._replace(health=health)
-        # 3. schedule the micro-batch
         if not self._pending:
+            # nothing to schedule: fold releases / health without the
+            # schedule phase (exact-size arrays; no padding subtleties)
+            if self._releases:
+                self.state = self._release_fn(self.state,
+                                              *self._release_arrays())
+            if self._health_updates:
+                ups, self._health_updates = self._health_updates, {}
+                self.state = set_health(self.state, list(ups.keys()),
+                                        list(ups.values()))
             return
+
         batch, self._pending = self._pending[: self.max_batch], \
             self._pending[self.max_batch:]
         t0 = time.monotonic()
@@ -448,7 +484,11 @@ class TpuBalancer(CommonLoadBalancer):
                           cols["step_inv"], cols["need_mb"], cols["conc_slot"],
                           cols["max_conc"], cols["rand"],
                           jnp.asarray([True] * b + [False] * (bp - b), bool))
-        self.state, chosen, forced = self._sched_fn(self.state, rb)
+        # releases + health flips + schedule: ONE device program
+        ri, rs, rm, rc, rv = self._release_arrays()
+        hidx, hval, hmask = self._health_arrays()
+        self.state, chosen, forced = self._fused_fn(
+            self.state, ri, rs, rm, rc, rv, hidx, hval, hmask, rb)
         chosen_np = np.asarray(chosen)
         forced_np = np.asarray(forced)
         dt_ms = (time.monotonic() - t0) * 1e3

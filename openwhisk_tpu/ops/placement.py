@@ -179,3 +179,31 @@ def release_batch(state: PlacementState, inv, slot, need_mb, max_conc, valid
         lambda s, r: _release_one(s, r),
         state, (inv, slot, need_mb, max_conc, valid))
     return new_state
+
+
+def make_fused_step(release_fn=None, schedule_fn=None):
+    """One jitted device program for the balancer's whole step:
+    fold releases -> fold health flips -> schedule the micro-batch.
+
+    The three phases as separate calls cost three dispatches per batch
+    (dominant at small fleet sizes, where each kernel is ~microseconds);
+    fused, XLA compiles them into a single program. Works over any
+    (release_fn, schedule_fn) pair — the XLA kernels (default), the
+    shard_map'd variants, or the pallas schedule.
+    """
+    release_fn = release_fn or release_batch
+    schedule_fn = schedule_fn or schedule_batch
+
+    @jax.jit
+    def fused(state: PlacementState, rel_inv, rel_slot, rel_mem, rel_maxc,
+              rel_valid, health_idx, health_val, health_valid,
+              batch: RequestBatch):
+        state = release_fn(state, rel_inv, rel_slot, rel_mem, rel_maxc,
+                           rel_valid)
+        # masked health fold: padded rows keep their current value
+        cur = state.health[health_idx]
+        state = state._replace(health=state.health.at[health_idx].set(
+            jnp.where(health_valid, health_val, cur)))
+        return schedule_fn(state, batch)
+
+    return fused
