@@ -57,6 +57,7 @@ class ControllerApi:
         r = app.router
         r.add_get("/ping", self.ping)
         r.add_get("/api/v1", self.api_info)
+        r.add_get("/api/v1/api-docs", self.api_docs)
         r.add_get("/api/v1/namespaces", self.list_namespaces)
         base = "/api/v1/namespaces/{ns}"
         # actions (name may contain a package segment)
@@ -89,7 +90,8 @@ class ControllerApi:
     # ----------------------------------------------------------- middleware
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
-        if request.path in ("/ping", "/api/v1", "/metrics") or \
+        if request.path in ("/ping", "/api/v1", "/metrics",
+                            "/api/v1/api-docs") or \
                 request.path.startswith("/api/v1/web/"):
             return await handler(request)
         identity = await self.c.authenticator.identity_from_header(
@@ -160,6 +162,88 @@ class ControllerApi:
                 "min_action_duration": TimeLimit.MIN_MS,
                 "min_action_memory": MemoryLimit.MIN.bytes,
             }})
+
+    _api_docs_cache: Optional[dict] = None
+
+    async def api_docs(self, request):
+        """Swagger 2.0 description of the REST surface (ref SwaggerDocs,
+        RestAPIs.scala:50-81). Static content, built once."""
+        if ControllerApi._api_docs_cache is not None:
+            return web.json_response(ControllerApi._api_docs_cache)
+
+        def crud(noun, extra_ops=None):
+            item = {
+                "get": {"summary": f"get {noun}", "responses": {"200": {"description": "ok"}}},
+                "put": {"summary": f"create/update {noun}",
+                        "parameters": [{"name": "overwrite", "in": "query", "type": "boolean"}],
+                        "responses": {"200": {"description": "ok"}, "409": {"description": "conflict"}}},
+                "delete": {"summary": f"delete {noun}", "responses": {"200": {"description": "ok"}}},
+            }
+            item.update(extra_ops or {})
+            return item
+
+        invoke_op = {"post": {
+            "summary": "invoke action",
+            "parameters": [{"name": "blocking", "in": "query", "type": "boolean"},
+                           {"name": "result", "in": "query", "type": "boolean"}],
+            "responses": {"200": {"description": "activation"},
+                          "202": {"description": "activation id"},
+                          "502": {"description": "action error"}}}}
+        def listing(noun):
+            return {"get": {"summary": f"list {noun}",
+                            "responses": {"200": {"description": "ok"}}}}
+
+        web_op = {"summary": "invoke web action (anonymous; any verb)",
+                  "responses": {"200": {"description": "ok"},
+                                "401": {"description": "require-whisk-auth"}}}
+        paths = {
+            "/api/v1": {"get": {"summary": "API info",
+                                "responses": {"200": {"description": "ok"}}}},
+            "/api/v1/namespaces": {"get": {"summary": "namespaces for identity",
+                                           "responses": {"200": {"description": "ok"}}}},
+            "/api/v1/namespaces/{ns}/actions": listing("actions"),
+            "/api/v1/namespaces/{ns}/actions/{name}": crud("action", invoke_op),
+            "/api/v1/namespaces/{ns}/triggers": listing("triggers"),
+            "/api/v1/namespaces/{ns}/triggers/{name}": crud("trigger", {
+                "post": {"summary": "fire trigger",
+                         "responses": {"202": {"description": "activation id"},
+                                       "204": {"description": "no active rules"}}}}),
+            "/api/v1/namespaces/{ns}/rules": listing("rules"),
+            "/api/v1/namespaces/{ns}/rules/{name}": crud("rule", {
+                "post": {"summary": "set rule status active/inactive",
+                         "responses": {"200": {"description": "ok"}}}}),
+            "/api/v1/namespaces/{ns}/packages": listing("packages"),
+            "/api/v1/namespaces/{ns}/packages/{name}": crud("package"),
+            "/api/v1/namespaces/{ns}/activations": {
+                "get": {"summary": "list activations",
+                        "parameters": [{"name": p, "in": "query", "type": "string"}
+                                       for p in ("name", "limit", "skip",
+                                                 "since", "upto", "docs")],
+                        "responses": {"200": {"description": "ok"}}}},
+            "/api/v1/namespaces/{ns}/activations/{id}": {
+                "get": {"summary": "activation record",
+                        "responses": {"200": {"description": "ok"}}}},
+            "/api/v1/namespaces/{ns}/activations/{id}/logs": {
+                "get": {"summary": "activation logs",
+                        "responses": {"200": {"description": "ok"}}}},
+            "/api/v1/namespaces/{ns}/activations/{id}/result": {
+                "get": {"summary": "activation result",
+                        "responses": {"200": {"description": "ok"}}}},
+            "/api/v1/namespaces/{ns}/apis": {
+                "get": {"summary": "list API routes", "responses": {"200": {"description": "ok"}}},
+                "post": {"summary": "create API route", "responses": {"200": {"description": "ok"}}},
+                "delete": {"summary": "delete API route", "responses": {"204": {"description": "ok"}}}},
+            "/api/v1/web/{ns}/{pkg}/{name}": {
+                verb: dict(web_op) for verb in
+                ("get", "post", "put", "delete", "patch", "head")},
+        }
+        ControllerApi._api_docs_cache = {
+            "swagger": "2.0",
+            "info": {"title": "OpenWhisk-TPU", "version": "1.0.0"},
+            "basePath": "/",
+            "paths": paths,
+        }
+        return web.json_response(ControllerApi._api_docs_cache)
 
     async def invokers(self, request):
         health = await self.c.load_balancer.invoker_health()

@@ -113,6 +113,10 @@ class Controller:
         self.cache_invalidation.start()
         if hasattr(self.load_balancer, "start"):
             await self.load_balancer.start()
+        if hasattr(self.load_balancer, "prepare_health_test_action"):
+            # system test action for probing unhealthy invokers
+            # (ref InvokerPool.prepare, InvokerSupervision.scala:239-252)
+            await self.load_balancer.prepare_health_test_action(self.entity_store)
         app = self.api.make_app()
         self._runner = web.AppRunner(app)
         await self._runner.setup()

@@ -121,6 +121,47 @@ class CommonLoadBalancer(LoadBalancer):
         self._total = 0
         self._ack_feed: Optional[MessageFeed] = None
 
+    # -- health test actions (ref InvokerPool.prepare + healthAction) ------
+    HEALTH_ACTION_NAMESPACE = "whisk.system"
+
+    async def prepare_health_test_action(self, entity_store) -> None:
+        """Write the system no-op test action
+        (`whisk.system/invokerHealthTestAction<controller>`, ref
+        InvokerSupervision.scala:239-252) and switch the supervision FSM to
+        probing unhealthy invokers with real test activations instead of
+        optimistic window re-opens. Healthcheck acks come back untracked and
+        feed on_invocation_finished via the 4-way disambiguation."""
+        from ...core.entity import (CodeExec, EntityName, EntityPath,
+                                    FullyQualifiedEntityName)
+        name = f"invokerHealthTestAction{self.controller.name}"
+        action = WhiskAction(
+            namespace=EntityPath(self.HEALTH_ACTION_NAMESPACE),
+            name=EntityName(name),
+            exec=CodeExec(kind="python:3",
+                          code="def main(args):\n    return {}\n"))
+        from ...database import DocumentConflict
+        try:
+            await entity_store.put(action)
+        except DocumentConflict:
+            pass  # already present from a previous boot
+        self._health_action_fqn = FullyQualifiedEntityName(
+            EntityPath(self.HEALTH_ACTION_NAMESPACE), EntityName(name))
+        self._system_identity = Identity.generate(self.HEALTH_ACTION_NAMESPACE)
+        supervision = getattr(self, "supervision", None)
+        if supervision is not None:
+            supervision.send_test_action = self._send_health_test_action
+
+    async def _send_health_test_action(self, invoker: InvokerInstanceId
+                                       ) -> None:
+        from ...core.entity import ActivationId
+        msg = ActivationMessage(
+            transid=TransactionId(system=True),
+            action=self._health_action_fqn, revision=None,
+            user=self._system_identity, activation_id=ActivationId.generate(),
+            root_controller_index=self.controller, blocking=False, content={})
+        await self.producer.send(invoker.as_string, msg)
+        self.metrics.counter("loadbalancer_health_test_actions")
+
     # -- counters (ref :60-99) --------------------------------------------
     def active_activations_for(self, namespace_id: str) -> int:
         return self.activations_per_namespace.get(namespace_id, 0)

@@ -457,8 +457,8 @@ class TpuBalancer(CommonLoadBalancer):
 
     async def _device_step(self) -> None:
         if not self._pending:
-            # nothing to schedule: fold releases / health without the
-            # schedule phase (exact-size arrays; no padding subtleties)
+            # nothing to schedule: fold releases (padded+masked like the
+            # fused path) and health (exact-size; dict keys are unique)
             if self._releases:
                 self.state = self._release_fn(self.state,
                                               *self._release_arrays())

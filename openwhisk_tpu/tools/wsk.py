@@ -159,6 +159,9 @@ async def run(args) -> int:
             path = f"/namespaces/{ns}/packages" + \
                 ("" if args.cmd == "list" else f"/{args.name}")
             return show(*await client.request(method, path))
+    elif e == "namespace":
+        if args.cmd == "list":
+            return show(*await client.request("GET", "/namespaces"))
     elif e == "api":
         # reference: wsk api create BASE_PATH API_PATH VERB ACTION — here the
         # positional slots map to name=basepath, artifact=relpath, with verb
@@ -208,7 +211,8 @@ def main(argv=None) -> int:
     parser.add_argument("--apihost", default=None)
     parser.add_argument("--auth", "-u", default=None)
     parser.add_argument("entity", choices=("action", "activation", "trigger",
-                                           "rule", "package", "api"))
+                                           "rule", "package", "api",
+                                           "namespace"))
     parser.add_argument("cmd")
     parser.add_argument("name", nargs="?")
     parser.add_argument("artifact", nargs="?")

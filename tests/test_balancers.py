@@ -469,3 +469,47 @@ class TestPallasKernelOption:
                           action_slots=4096, initial_pad=1024,
                           kernel="pallas")
         assert bal.kernel == "xla"  # 1024x4096 state exceeds the VMEM budget
+
+
+class TestHealthTestActions:
+    def test_unhealthy_invoker_gets_test_activation(self):
+        """ref InvokerSupervision: >3 system errors flip an invoker
+        Unhealthy; the controller then probes it with the system test
+        action (invokerHealthTestAction<controller>) instead of real
+        traffic, and its acks feed recovery."""
+        async def go():
+            from openwhisk_tpu.database import EntityStore, MemoryArtifactStore
+            from openwhisk_tpu.messaging.message import ActivationMessage
+
+            provider = MemoryMessagingProvider()
+            store = EntityStore(MemoryArtifactStore())
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            await bal.start()
+            await bal.prepare_health_test_action(store)
+            # the system action exists in the store
+            doc = await store.get_action("whisk.system/invokerHealthTestAction0")
+            assert doc is not None
+
+            inv = InvokerInstanceId(0, user_memory=MB(2048))
+            producer = provider.get_producer()
+            provider.ensure_topic("invoker0")
+            probe = provider.get_consumer("invoker0", "probe")
+            await producer.send("health", PingMessage(inv))
+            await asyncio.sleep(0.15)
+            # 4 system errors -> Unhealthy
+            for _ in range(4):
+                bal.supervision.on_invocation_finished(inv, True, False)
+            assert bal.supervision.health()[0].status == "unhealthy"
+            # next ping triggers the test-action probe (cooldown starts at 0)
+            await producer.send("health", PingMessage(inv))
+            await asyncio.sleep(0.2)
+            msgs = await probe.peek(10, timeout=1.0)
+            await bal.close()
+            assert msgs, "no test activation published to the invoker topic"
+            parsed = ActivationMessage.parse(msgs[0][3])
+            return str(parsed.action), parsed.blocking
+
+        action, blocking = asyncio.run(go())
+        assert action == "whisk.system/invokerHealthTestAction0"
+        assert blocking is False

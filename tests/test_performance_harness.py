@@ -68,3 +68,13 @@ class TestOwperf:
         phases = [l.split(",")[0] for l in lines[1:]]
         assert phases == ["action_e2e", "rule_e2e_x1", "waitTime", "initTime",
                           "duration"]
+
+
+class TestWarmHitParity:
+    def test_kernel_matches_oracle_warm_rates(self):
+        import warmhit
+        out = warmhit.simulate(n_invokers=24, rounds=6, batch=48,
+                               n_actions=16)
+        assert out["decision_parity"] == 1.0
+        assert out["kernel_warm_rate"] == out["oracle_warm_rate"]
+        assert out["kernel_warm_rate"] > 0.5  # the workload produces warm hits

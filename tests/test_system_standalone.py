@@ -412,3 +412,18 @@ class TestBinaryActionEndToEnd:
         anon, good = run_system(go)
         assert anon == 401
         assert good == 200
+
+
+class TestApiDocs:
+    def test_swagger_served_unauthenticated(self):
+        async def go(s):
+            async with s.get(f"{BASE}/api-docs") as r:
+                return r.status, await r.json()
+
+        status, doc = run_system(go)
+        assert status == 200
+        assert doc["swagger"] == "2.0"
+        paths = doc["paths"]
+        assert "/api/v1/namespaces/{ns}/actions/{name}" in paths
+        assert "post" in paths["/api/v1/namespaces/{ns}/actions/{name}"]
+        assert "/api/v1/namespaces/{ns}/apis" in paths
