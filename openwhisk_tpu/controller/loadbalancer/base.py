@@ -120,6 +120,7 @@ class CommonLoadBalancer(LoadBalancer):
         self.activations_per_namespace: Dict[str, int] = {}
         self._total = 0
         self._ack_feed: Optional[MessageFeed] = None
+        self._health_probe_ids: set = set()
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -143,7 +144,12 @@ class CommonLoadBalancer(LoadBalancer):
         try:
             await entity_store.put(action)
         except DocumentConflict:
-            pass  # already present from a previous boot
+            # present from a previous boot: re-put at the stored revision so
+            # a changed definition takes effect (ref InvokerPool.prepare)
+            existing = await entity_store.get_action(
+                f"{self.HEALTH_ACTION_NAMESPACE}/{name}")
+            action.rev = existing.rev
+            await entity_store.put(action)
         self._health_action_fqn = FullyQualifiedEntityName(
             EntityPath(self.HEALTH_ACTION_NAMESPACE), EntityName(name))
         self._system_identity = Identity.generate(self.HEALTH_ACTION_NAMESPACE)
@@ -154,12 +160,17 @@ class CommonLoadBalancer(LoadBalancer):
     async def _send_health_test_action(self, invoker: InvokerInstanceId
                                        ) -> None:
         from ...core.entity import ActivationId
+        aid = ActivationId.generate()
         msg = ActivationMessage(
             transid=TransactionId(system=True),
             action=self._health_action_fqn, revision=None,
-            user=self._system_identity, activation_id=ActivationId.generate(),
+            user=self._system_identity, activation_id=aid,
             root_controller_index=self.controller, blocking=False, content={})
-        await self.producer.send(invoker.as_string, msg)
+        # remember probe ids so their acks disambiguate as healthchecks
+        self._health_probe_ids.add(aid.asString)
+        while len(self._health_probe_ids) > 1024:
+            self._health_probe_ids.pop()
+        await self.send_activation_to_invoker(msg, invoker)
         self.metrics.counter("loadbalancer_health_test_actions")
 
     # -- counters (ref :60-99) --------------------------------------------
@@ -290,8 +301,14 @@ class CommonLoadBalancer(LoadBalancer):
                                         is_system_error=is_system_error,
                                         forced=forced)
         else:
-            # late ack after a forced completion, or healthcheck ack
-            if not forced:
+            # untracked ack: healthcheck (a test-action probe we sent), or a
+            # late ack after a forced completion — the 4-way disambiguation
+            if aid.asString in self._health_probe_ids:
+                self._health_probe_ids.discard(aid.asString)
+                self.metrics.counter("loadbalancer_completion_ack_healthcheck")
+                self.on_invocation_finished(invoker, is_system_error=is_system_error,
+                                            forced=forced)
+            elif not forced:
                 self.metrics.counter("loadbalancer_completion_ack_regularAfterForced")
                 self.on_invocation_finished(invoker, is_system_error=is_system_error,
                                             forced=False)

@@ -46,7 +46,11 @@ class InvokerActorState:
     status: str = OFFLINE
     last_ping: float = 0.0
     buffer: RingBuffer = field(default_factory=lambda: RingBuffer(BUFFER_SIZE))
-    last_recovery_attempt: float = 0.0
+    # seed one cooldown in the past: the FIRST probe of an unhealthy invoker
+    # must fire immediately (time.monotonic() is host uptime — a bare 0.0
+    # default would suppress probes on freshly-booted hosts)
+    last_recovery_attempt: float = field(
+        default_factory=lambda: time.monotonic() - RECOVERY_COOLDOWN_S)
 
     def classify(self) -> str:
         """Derive the health status from the outcome window (:435-443)."""

@@ -513,3 +513,30 @@ class TestHealthTestActions:
         action, blocking = asyncio.run(go())
         assert action == "whisk.system/invokerHealthTestAction0"
         assert blocking is False
+
+    def test_healthcheck_ack_counts_as_healthcheck(self):
+        """Probe acks must hit the healthcheck counter, not pollute the
+        late-ack (regularAfterForced) metric operators watch."""
+        async def go():
+            from openwhisk_tpu.core.entity import ActivationId
+            from openwhisk_tpu.database import EntityStore, MemoryArtifactStore
+
+            provider = MemoryMessagingProvider()
+            store = EntityStore(MemoryArtifactStore())
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            await bal.start()
+            await bal.prepare_health_test_action(store)
+            inv = InvokerInstanceId(0, user_memory=MB(2048))
+            await bal._send_health_test_action(inv)
+            aid = next(iter(bal._health_probe_ids))
+            bal.process_completion(ActivationId(aid), forced=False,
+                                   is_system_error=False, invoker=inv)
+            hc = bal.metrics.counter_value("loadbalancer_completion_ack_healthcheck")
+            late = bal.metrics.counter_value("loadbalancer_completion_ack_regularAfterForced")
+            await bal.close()
+            return hc, late, aid in bal._health_probe_ids
+
+        hc, late, still_tracked = asyncio.run(go())
+        assert hc == 1 and late == 0
+        assert not still_tracked

@@ -36,7 +36,9 @@ def _free_port():
 class Cluster:
     """Popen-based mini-deployment with per-service kill/restart."""
 
-    def __init__(self, tmp_path, n_controllers=1, edge=False, ctrl_env=None):
+    def __init__(self, tmp_path, n_controllers=1, edge=False, ctrl_env=None,
+                 balancer="sharding"):
+        self.balancer = balancer
         self.db = str(tmp_path / "whisks.db")
         self.bus_port = _free_port()
         self.ctrl_ports = [_free_port() for _ in range(n_controllers)]
@@ -59,7 +61,7 @@ class Cluster:
                     "--bus", f"127.0.0.1:{self.bus_port}", "--db", self.db,
                     "--port", str(port), "--instance", str(i),
                     "--cluster-size", str(len(self.ctrl_ports)),
-                    "--balancer", "sharding"]
+                    "--balancer", self.balancer]
             if i == 0:
                 argv.append("--seed-guest")
             self.spawn(f"controller{i}", argv)
@@ -230,5 +232,89 @@ class TestThrottlesOverHttp:
             assert statuses[:2] == [200, 200]
             assert 429 in statuses[2:], statuses
             assert "error" in last_body
+        finally:
+            cluster.stop()
+
+
+@pytest.mark.slow
+class TestTpuBalancerDistributed:
+    def test_tpu_balancer_multi_process(self, tmp_path):
+        """The TPU placement path in true distributed mode: controller with
+        the device kernel balancer as its own OS process, invoker + bus
+        beside it, blocking invokes over HTTP. (Subprocesses pin JAX to the
+        CPU backend so tests never contend for the tunneled chip.)"""
+        env = {"JAX_PLATFORMS": "cpu"}
+        cluster = Cluster(tmp_path, n_controllers=1, balancer="tpu",
+                          ctrl_env=env)
+        cluster.start()
+        try:
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    assert await cluster.wait_healthy(s, timeout=120)
+                    base = cluster.api()
+                    async with s.put(f"{base}/namespaces/_/actions/tdist",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": CODE}}) as r:
+                        assert r.status == 200, await r.text()
+                    results = await asyncio.gather(*[
+                        s.post(f"{base}/namespaces/_/actions/tdist"
+                               "?blocking=true&result=true",
+                               headers=HDRS, json={"n": i}).__aenter__()
+                        for i in range(6)])
+                    out = []
+                    for r in results:
+                        out.append((r.status, await r.json()))
+                        r.release()
+                    return out
+
+            out = asyncio.run(drive())
+            assert all(st == 200 and body["alive"] for st, body in out), out
+            assert sorted(body["n"] for _, body in out) == list(range(6))
+        finally:
+            cluster.stop()
+
+
+@pytest.mark.slow
+class TestUserEventsService:
+    def test_monitoring_process_exports_prometheus(self, tmp_path):
+        """The standalone user-events service consumes the events topic from
+        the bus and serves Prometheus series (ref core/monitoring)."""
+        cluster = Cluster(tmp_path, n_controllers=1)
+        cluster.start()
+        mon_port = _free_port()
+        cluster.spawn("monitoring",
+                      [sys.executable, "-m",
+                       "openwhisk_tpu.controller.monitoring",
+                       "--bus", f"127.0.0.1:{cluster.bus_port}",
+                       "--port", str(mon_port)])
+        try:
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    assert await cluster.wait_healthy(s)
+                    base = cluster.api()
+                    async with s.put(f"{base}/namespaces/_/actions/mon",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": CODE}}) as r:
+                        assert r.status == 200
+                    async with s.post(
+                            f"{base}/namespaces/_/actions/mon?blocking=true",
+                            headers=HDRS, json={}) as r:
+                        assert r.status == 200
+                    for _ in range(40):
+                        try:
+                            async with s.get(
+                                    f"http://127.0.0.1:{mon_port}/metrics") as r:
+                                text = await r.text()
+                                if "userevents_activations" in text:
+                                    return text
+                        except aiohttp.ClientError:
+                            pass
+                        await asyncio.sleep(0.5)
+                    raise AssertionError("user-events series never appeared")
+
+            text = asyncio.run(drive())
+            assert "userevents_activations_" in text
         finally:
             cluster.stop()
