@@ -427,3 +427,53 @@ class TestApiDocs:
         assert "/api/v1/namespaces/{ns}/actions/{name}" in paths
         assert "post" in paths["/api/v1/namespaces/{ns}/actions/{name}"]
         assert "/api/v1/namespaces/{ns}/apis" in paths
+
+
+class TestPackageBindings:
+    def test_invoke_through_binding_merges_parameters(self):
+        """ref Packages.scala bindings: a binding references a provider
+        package; invoking <binding>/<action> resolves the provider's action
+        with parameter precedence provider < binding < invoke args."""
+        CODE = ("def main(args):\n"
+                "    return {'who': args.get('who'), 'tier': args.get('tier')}\n")
+
+        async def go(s):
+            # provider package with params + an action inside it
+            async with s.put(f"{BASE}/namespaces/_/packages/prov", headers=HDRS,
+                             json={"parameters": [
+                                 {"key": "who", "value": "provider"},
+                                 {"key": "tier", "value": "base"}]}) as r:
+                assert r.status == 200
+            async with s.put(f"{BASE}/namespaces/_/actions/prov/whoami",
+                             headers=HDRS,
+                             json={"exec": {"kind": "python:3",
+                                            "code": CODE}}) as r:
+                assert r.status == 200, await r.text()
+            # binding overriding one param
+            async with s.put(f"{BASE}/namespaces/_/packages/bnd", headers=HDRS,
+                             json={"binding": {"namespace": "guest",
+                                               "name": "prov"},
+                                   "parameters": [
+                                       {"key": "who", "value": "binding"}]}) as r:
+                assert r.status == 200, await r.text()
+            out = {}
+            # invoke through the binding: binding param wins over provider's
+            async with s.post(
+                    f"{BASE}/namespaces/_/actions/bnd/whoami?blocking=true&result=true",
+                    headers=HDRS, json={}) as r:
+                out["bound"] = (r.status, await r.json())
+            # invoke args beat both
+            async with s.post(
+                    f"{BASE}/namespaces/_/actions/bnd/whoami?blocking=true&result=true",
+                    headers=HDRS, json={"who": "caller"}) as r:
+                out["args"] = await r.json()
+            # binding document lists the provider reference
+            async with s.get(f"{BASE}/namespaces/_/packages/bnd",
+                             headers=HDRS) as r:
+                out["doc"] = await r.json()
+            return out
+
+        out = run_system(go)
+        assert out["bound"] == (200, {"who": "binding", "tier": "base"})
+        assert out["args"] == {"who": "caller", "tier": "base"}
+        assert out["doc"]["binding"] == {"namespace": "guest", "name": "prov"}
