@@ -23,7 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 SENTINEL = "XXX_THE_END_OF_A_WHISK_ACTIVATION_XXX"
 
-_state = {"fn": None, "env": {}}
+_state = {"fn": None, "env": {}, "workdir": None}
 
 
 def _compile_action(code: str, main: str):
@@ -56,9 +56,42 @@ def _compile_binary_action(b64_zip: str, main: str):
     entry = os.path.join(workdir, "__main__.py")
     if not os.path.exists(entry):
         raise ValueError("Initialization has failed: zip has no __main__.py")
+    import shutil
+
+    # Re-init: the previous zip's path entry and modules must not shadow
+    # imports of the new code — but a failed re-init must leave the old
+    # action fully working, so evict recoverably and clean up only after
+    # the new archive compiles.
+    prev = _state.get("workdir")
+    evicted: dict = {}
+    prev_in_path = prev is not None and prev in sys.path
+    if prev is not None:
+        if prev_in_path:
+            sys.path.remove(prev)
+        for name, mod in list(sys.modules.items()):
+            if getattr(mod, "__file__", None) and \
+                    str(mod.__file__).startswith(prev + os.sep):
+                evicted[name] = sys.modules.pop(name)
     sys.path.insert(0, workdir)
-    with open(entry) as f:
-        return _compile_action(f.read(), main)
+    try:
+        with open(entry) as f:
+            fn = _compile_action(f.read(), main)
+    except BaseException:
+        if workdir in sys.path:
+            sys.path.remove(workdir)
+        for name, mod in list(sys.modules.items()):
+            if getattr(mod, "__file__", None) and \
+                    str(mod.__file__).startswith(workdir + os.sep):
+                del sys.modules[name]
+        if prev_in_path:
+            sys.path.insert(0, prev)
+        sys.modules.update(evicted)
+        shutil.rmtree(workdir, ignore_errors=True)
+        raise
+    if prev is not None:
+        shutil.rmtree(prev, ignore_errors=True)
+    _state["workdir"] = workdir
+    return fn
 
 
 class Handler(BaseHTTPRequestHandler):

@@ -181,6 +181,13 @@ class TpuBalancer(CommonLoadBalancer):
         # dispatches per micro-batch)
         self._fused_fn = make_fused_step(self._release_fn, self._sched_fn)
 
+    def _use_xla_kernels(self) -> None:
+        """Swap the XLA schedule/release kernels in (pallas state outgrew
+        the VMEM budget, via growth or snapshot restore)."""
+        self._sched_fn = schedule_batch
+        self._release_fn = release_batch
+        self._fused_fn = make_fused_step(self._release_fn, self._sched_fn)
+
     def _pallas_fits(self) -> bool:
         from ...ops.placement_pallas import fits_vmem
         if fits_vmem(self._n_pad, self.action_slots):
@@ -241,10 +248,7 @@ class TpuBalancer(CommonLoadBalancer):
             state = shard_state(state, self.mesh)
         self.state = state
         if self.kernel == "pallas" and not self._pallas_fits():
-            # grown past the VMEM budget: swap in the XLA kernel
-            self._sched_fn = schedule_batch
-            self._release_fn = release_batch
-            self._fused_fn = make_fused_step(self._release_fn, self._sched_fn)
+            self._use_xla_kernels()
 
     def _recompute_partitions(self) -> None:
         n = len(self._registry)
@@ -383,6 +387,9 @@ class TpuBalancer(CommonLoadBalancer):
         self._slots.free = [s for s in range(self.action_slots - 1, -1, -1)
                             if s not in used]
         self._recompute_partitions()
+        if self.kernel == "pallas" and not self._pallas_fits():
+            # snapshot may carry an _n_pad past the pallas VMEM budget
+            self._use_xla_kernels()
 
     # -- the device step ---------------------------------------------------
     def _arm_flush(self, urgent: bool = False) -> None:

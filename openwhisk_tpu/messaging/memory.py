@@ -104,10 +104,11 @@ class MemoryConsumer(MessageConsumer):
         if group in t.groups:
             pass
         elif from_latest:
-            t.queue_for(group)  # new group, starts empty
-            # the stream has a live consumer now; pre-subscription retention
-            # is over (nothing should ever replay it)
-            t.groups.pop("__default__", None)
+            # New group starts empty; the pre-subscription backlog in
+            # __default__ stays retained for a later queue-semantics group
+            # (it is bounded by the topic's retention cap, so an
+            # ephemeral-stream topic like health keeps only a small tail).
+            t.queue_for(group)
         elif "__default__" in t.groups:
             t.groups[group] = t.groups.pop("__default__")
         else:
@@ -118,14 +119,18 @@ class MemoryConsumer(MessageConsumer):
                    ) -> List[Tuple[str, int, int, bytes]]:
         n = min(max_messages, self.max_peek)
         t = self.bus.topic(self.topic_name)
-        q = t.queue_for(self.group)
         out: List[Tuple[str, int, int, bytes]] = []
         async with t.cond:
-            if not q:
+            # look the queue up inside the predicate: set_max_messages may
+            # swap the deque object while we are parked on the condition
+            if not t.queue_for(self.group):
                 try:
-                    await asyncio.wait_for(t.cond.wait_for(lambda: len(q) > 0), timeout)
+                    await asyncio.wait_for(
+                        t.cond.wait_for(
+                            lambda: len(t.queue_for(self.group)) > 0), timeout)
                 except asyncio.TimeoutError:
                     return []
+            q = t.queue_for(self.group)
             while q and len(out) < n:
                 off, payload = q.popleft()
                 out.append((self.topic_name, 0, off, payload))

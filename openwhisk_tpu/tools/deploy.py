@@ -56,11 +56,28 @@ def load_inventory(path: Optional[str]) -> dict:
     return inv
 
 
+#: limit keys the controller actually reads (controller/__main__.py); the
+#: env channel splits on "_", so only camelCase spellings survive the
+#: round-trip through config_from_env
+KNOWN_LIMIT_KEYS = ("invocationsPerMinute", "concurrentInvocations",
+                    "firesPerMinute")
+
+
+def _camel(key: str) -> str:
+    parts = key.split("_")
+    return parts[0] + "".join(p[:1].upper() + p[1:] for p in parts[1:] if p)
+
+
 def _config_env(inv: dict) -> Dict[str, str]:
     """Only the inventory-derived CONFIG_* keys (what renderers persist)."""
     env: Dict[str, str] = {}
     for k, v in inv.get("limits", {}).items():
-        env[f"CONFIG_whisk_limits_{k}"] = str(v)
+        key = _camel(k)  # accept snake_case inventories
+        if key not in KNOWN_LIMIT_KEYS:
+            raise ValueError(
+                f"inventory limits key {k!r} is not a recognized limit "
+                f"(expected one of {', '.join(KNOWN_LIMIT_KEYS)})")
+        env[f"CONFIG_whisk_limits_{key}"] = str(v)
     for k, v in inv.get("config", {}).items():
         key = k if k.startswith("CONFIG_") else f"CONFIG_whisk_{k}"
         env[key] = str(v)

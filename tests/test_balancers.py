@@ -540,3 +540,20 @@ class TestHealthTestActions:
         hc, late, still_tracked = asyncio.run(go())
         assert hc == 1 and late == 0
         assert not still_tracked
+
+    def test_restore_past_vmem_budget_falls_back_to_xla(self):
+        """A snapshot whose n_pad exceeds the pallas VMEM budget must swap
+        in the XLA kernel on restore, exactly as _grow_padding does."""
+        from openwhisk_tpu.ops.placement import release_batch, schedule_batch
+
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          action_slots=4096, initial_pad=1024)
+        snap = bal.snapshot()
+
+        small = TpuBalancer(MemoryMessagingProvider(), ControllerInstanceId("0"),
+                            action_slots=4096, initial_pad=1, kernel="pallas")
+        assert small.kernel == "pallas"
+        small.restore(snap)
+        assert small._sched_fn is schedule_batch
+        assert small._release_fn is release_batch

@@ -118,3 +118,121 @@ def test_attachment_conflict_loser_cannot_corrupt_winner_code():
 
     code = run(go())
     assert "'A2'" in code and "'B'" not in code  # winner doc ↔ winner code
+
+
+def test_from_latest_subscriber_keeps_topic_backlog_for_queue_groups():
+    """A from_latest consumer (health stream) must not destroy the
+    pre-subscription backlog retained for a later queue-semantics group."""
+    async def go():
+        bus = MemoryMessagingProvider()
+        prod = bus.get_producer()
+        await prod.send("t", b"retained-1")
+        await prod.send("t", b"retained-2")
+        bus.get_consumer("t", "stream", from_latest=True)  # must not eat backlog
+        queue_consumer = bus.get_consumer("t", "workers")
+        got = await queue_consumer.peek(10, timeout=0.05)
+        return [payload for _, _, _, payload in got]
+    assert run(go()) == [b"retained-1", b"retained-2"]
+
+
+def test_peek_survives_retention_resize_while_waiting():
+    """set_max_messages swaps the group deque; a consumer parked in peek()
+    must still see messages appended to the replacement deque."""
+    async def go():
+        bus = MemoryMessagingProvider()
+        consumer = bus.get_consumer("t", "g")
+        prod = bus.get_producer()
+
+        async def resize_then_send():
+            await asyncio.sleep(0.02)
+            bus.bus.topic("t").set_max_messages(16)
+            await prod.send("t", b"after-resize")
+
+        task = asyncio.ensure_future(resize_then_send())
+        got = await consumer.peek(1, timeout=2.0)
+        await task
+        return got
+    got = run(go())
+    assert [p for _, _, _, p in got] == [b"after-resize"]
+
+
+def test_deploy_limits_keys_normalized_and_validated():
+    from openwhisk_tpu.tools.deploy import _config_env
+    env = _config_env({"limits": {"invocations_per_minute": 120}})
+    assert env == {"CONFIG_whisk_limits_invocationsPerMinute": "120"}
+    with pytest.raises(ValueError):
+        _config_env({"limits": {"invocationsPerHour": 9}})
+
+
+def test_actionproxy_reinit_drops_previous_zip_from_sys_path():
+    import base64
+    import io
+    import sys
+    import zipfile
+
+    from openwhisk_tpu.containerpool import actionproxy
+
+    def zip_b64(helper_body: str, main_body: str) -> str:
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            z.writestr("helper.py", helper_body)
+            z.writestr("__main__.py", main_body)
+        return base64.b64encode(buf.getvalue()).decode()
+
+    main_src = "import helper\ndef main(args):\n    return {'v': helper.VALUE}\n"
+    saved = actionproxy._state.get("workdir")
+    try:
+        fn1 = actionproxy._compile_binary_action(zip_b64("VALUE = 1", main_src), "main")
+        assert fn1({}) == {"v": 1}
+        first_dir = actionproxy._state["workdir"]
+        fn2 = actionproxy._compile_binary_action(zip_b64("VALUE = 2", main_src), "main")
+        assert fn2({}) == {"v": 2}  # stale helper module must not shadow
+        assert first_dir not in sys.path
+    finally:
+        wd = actionproxy._state.get("workdir")
+        if wd and wd in sys.path:
+            sys.path.remove(wd)
+        actionproxy._state["workdir"] = saved
+        sys.modules.pop("helper", None)
+
+
+def test_actionproxy_failed_reinit_leaves_previous_action_working():
+    """A re-init whose zip does not compile must not break the installed
+    action: its modules, path entry, and workdir survive the failure."""
+    import base64
+    import io
+    import os
+    import sys
+    import zipfile
+
+    from openwhisk_tpu.containerpool import actionproxy
+
+    def zip_b64(files: dict) -> str:
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as z:
+            for name, body in files.items():
+                z.writestr(name, body)
+        return base64.b64encode(buf.getvalue()).decode()
+
+    good = zip_b64({"helper.py": "VALUE = 7",
+                    "__main__.py": "import helper\n"
+                                   "def main(args):\n"
+                                   "    import helper as h\n"
+                                   "    return {'v': h.VALUE}\n"})
+    bad = zip_b64({"__main__.py": "not_main = 1\n"})  # no callable main
+    saved = actionproxy._state.get("workdir")
+    try:
+        fn = actionproxy._compile_binary_action(good, "main")
+        assert fn({}) == {"v": 7}
+        good_dir = actionproxy._state["workdir"]
+        with pytest.raises(ValueError):
+            actionproxy._compile_binary_action(bad, "main")
+        assert actionproxy._state["workdir"] == good_dir
+        assert good_dir in sys.path and os.path.isdir(good_dir)
+        assert fn({}) == {"v": 7}  # helper import still resolves
+    finally:
+        wd = actionproxy._state.get("workdir")
+        if wd and wd in sys.path:
+            sys.path.remove(wd)
+        actionproxy._state["workdir"] = saved
+        sys.modules.pop("helper", None)
