@@ -26,6 +26,48 @@ SENTINEL = "XXX_THE_END_OF_A_WHISK_ACTIVATION_XXX"
 _state = {"fn": None, "env": {}, "workdir": None}
 
 
+class _InitRunGate:
+    """Reader-writer gate for the ThreadingHTTPServer: /run requests run
+    concurrently (intra-container concurrency), but a re-/init waits for
+    in-flight runs to drain and blocks new ones — it evicts the previous
+    zip's modules and deletes its workdir, which a concurrently executing
+    old action could still be importing from."""
+
+    def __init__(self):
+        import threading
+
+        self._cond = threading.Condition()
+        self._runs = 0
+        self._initing = False
+
+    def begin_run(self) -> None:
+        with self._cond:
+            while self._initing:
+                self._cond.wait()
+            self._runs += 1
+
+    def end_run(self) -> None:
+        with self._cond:
+            self._runs -= 1
+            self._cond.notify_all()
+
+    def begin_init(self) -> None:
+        with self._cond:
+            while self._initing:
+                self._cond.wait()
+            self._initing = True
+            while self._runs:
+                self._cond.wait()
+
+    def end_init(self) -> None:
+        with self._cond:
+            self._initing = False
+            self._cond.notify_all()
+
+
+_gate = _InitRunGate()
+
+
 def _compile_action(code: str, main: str):
     scope: dict = {}
     exec(compile(code, "<action>", "exec"), scope)  # noqa: S102 — this IS the sandbox body
@@ -131,6 +173,7 @@ class Handler(BaseHTTPRequestHandler):
         value = payload.get("value", {})
         code = value.get("code", "")
         main = value.get("main") or "main"
+        _gate.begin_init()
         try:
             if value.get("binary"):
                 _state["fn"] = _compile_binary_action(code, main)
@@ -144,8 +187,17 @@ class Handler(BaseHTTPRequestHandler):
             self._reply(200, {"ok": True})
         except Exception as e:  # noqa: BLE001 — report any user-code failure
             self._reply(502, {"error": f"Initialization has failed: {e}"})
+        finally:
+            _gate.end_init()
 
     def _run(self, payload: dict) -> None:
+        _gate.begin_run()
+        try:
+            self._run_locked(payload)
+        finally:
+            _gate.end_run()
+
+    def _run_locked(self, payload: dict) -> None:
         if _state["fn"] is None:
             self._reply(502, {"error": "cannot invoke an uninitialized action"})
             return

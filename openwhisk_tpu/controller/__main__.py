@@ -14,7 +14,7 @@ import argparse
 import asyncio
 
 from ..core.entity import ControllerInstanceId, ExecManifest, WhiskAuthRecord
-from ..database import SqliteArtifactStore
+from ..database import open_store
 from ..messaging.tcp import TcpMessagingProvider
 from ..utils.config import config_from_env
 from ..utils.logging import Logging
@@ -40,7 +40,7 @@ def main() -> None:
         ExecManifest.initialize()
         host, _, port = args.bus.partition(":")
         provider = TcpMessagingProvider(host, int(port or 4222))
-        store = SqliteArtifactStore(args.db)
+        store = open_store(args.db)
         instance = ControllerInstanceId(args.instance)
         if args.balancer == "tpu":
             from .loadbalancer.tpu_balancer import TpuBalancer

@@ -6,6 +6,8 @@ from .attachment_store import (AttachmentStore, FileAttachmentStore,
                                MemoryAttachmentStoreProvider)
 from .memory_store import MemoryArtifactStore, MemoryArtifactStoreProvider
 from .sqlite_store import SqliteArtifactStore, SqliteArtifactStoreProvider
+from .remote_store import (DocStoreServer, RemoteArtifactStore,
+                           RemoteArtifactStoreProvider, open_store)
 from .batcher import Batcher
 from .cache import EntityCache, RemoteCacheInvalidation
 from .change_feed import CacheInvalidatorService

@@ -15,7 +15,7 @@ import asyncio
 from ..containerpool import ContainerPoolConfig
 from ..containerpool.process_factory import ProcessContainerFactory
 from ..core.entity import ExecManifest, InvokerInstanceId, MB
-from ..database import ArtifactActivationStore, EntityStore, SqliteArtifactStore
+from ..database import ArtifactActivationStore, EntityStore, open_store
 from ..messaging.tcp import TcpMessagingProvider
 from ..utils.logging import Logging
 from .id_assigner import InstanceIdAssigner
@@ -42,7 +42,7 @@ def main() -> None:
         ExecManifest.initialize()
         host, _, port = args.bus.partition(":")
         provider = TcpMessagingProvider(host, int(port or 4222))
-        store = SqliteArtifactStore(args.db)
+        store = open_store(args.db)
         instance_id = await InstanceIdAssigner(store).assign(
             args.unique_name, args.id)
         instance = InvokerInstanceId(instance_id, unique_name=args.unique_name,

@@ -151,7 +151,8 @@ class _TcpConnection:
                         return resp
                 except (ConnectionResetError, BrokenPipeError, OSError):
                     pass
-                # reconnect once
+                # reconnect once; close the dead transport to free its fd
+                self.writer.close()
                 self.writer = None
             raise ConnectionError(f"bus at {self.host}:{self.port} unreachable")
 

@@ -25,8 +25,8 @@ def main() -> None:
     async def run():
         store = None
         if args.db:
-            from ..database import SqliteArtifactStore
-            store = SqliteArtifactStore(args.db)
+            from ..database import open_store
+            store = open_store(args.db)
         controller = await make_standalone(port=args.port, artifact_store=store,
                                            user_memory_mb=args.memory,
                                            prewarm=args.prewarm,

@@ -31,6 +31,7 @@ DEFAULT_INVENTORY = {
     "rundir": "ow-run",
     "db": "whisks.db",
     "bus": {"host": "127.0.0.1", "port": 4222},
+    "docstore": {"enabled": False, "host": "127.0.0.1", "port": 4223},
     "controllers": {"count": 1, "base_port": 3233, "balancer": "tpu"},
     "invokers": {"count": 1, "memory_mb": 2048, "prewarm": False},
     "edge": {"enabled": True, "port": 8080, "domain": ""},
@@ -107,6 +108,19 @@ def services(inv: dict, python: str = sys.executable,
                  "--host", net.get("bus_bind", bus["host"]),
                  "--port", str(bus["port"])],
     }]
+    ds = inv.get("docstore") or {}
+    if ds.get("enabled"):
+        # the shared persistence service (CouchDB-equivalent): controllers
+        # and invokers dial it instead of sharing a sqlite file path, which
+        # is what makes genuinely multi-host topologies possible
+        out.append({
+            "name": "docstore",
+            "argv": [python, "-m", "openwhisk_tpu.database.remote_store",
+                     "--db", db,
+                     "--host", net.get("docstore_bind", ds.get("host", "127.0.0.1")),
+                     "--port", str(ds.get("port", 4223))],
+        })
+        db = f"docstore://{net.get('docstore_host', ds.get('host', '127.0.0.1'))}:{ds.get('port', 4223)}"
     for i in range(inv["invokers"]["count"]):
         argv = [python, "-m", "openwhisk_tpu.invoker", "--bus", bus_addr,
                 "--db", db, "--unique-name", f"invoker-{i}",
@@ -151,8 +165,8 @@ def up(inv: dict) -> None:
             f.write(str(proc.pid))
         started.append((svc["name"], proc.pid))
         print(f"started {svc['name']} (pid {proc.pid})")
-        if svc["name"] == "bus":
-            time.sleep(1.0)  # services connect at boot; bus must be up first
+        if svc["name"] in ("bus", "docstore"):
+            time.sleep(1.0)  # services connect at boot; spine must be up first
     print(f"{len(started)} services up; logs + pids in {rundir}/")
 
 
@@ -236,16 +250,22 @@ def render_k8s(inv: dict, outdir: str) -> None:
              "metadata": {"name": "ow-shared-db"},
              "spec": {"accessModes": ["ReadWriteMany"],
                       "resources": {"requests": {"storage": "1Gi"}}}}]
-    ports = {"bus": inv["bus"]["port"], "edge": inv["edge"]["port"]}
+    ports = {"bus": inv["bus"]["port"], "edge": inv["edge"]["port"],
+             "docstore": (inv.get("docstore") or {}).get("port", 4223)}
     # pods find each other via their Service DNS names, not loopback
     net = {"bus_bind": "0.0.0.0", "bus_host": "ow-bus",
-           "controller_bind": "0.0.0.0", "controller_host": "ow-controller{i}"}
+           "controller_bind": "0.0.0.0", "controller_host": "ow-controller{i}",
+           "docstore_bind": "0.0.0.0", "docstore_host": "ow-docstore"}
     db_file = os.path.basename(inv["db"])
     for svc in services(inv, python="python3", net=net):
         name = f"ow-{svc['name']}"
         argv = list(svc["argv"])
         pod_spec: dict = {}
-        if "--db" in argv:
+        # a docstore:// URL needs no volume — only file-backed --db args
+        # (every service in file mode; only the docstore pod in URL mode)
+        needs_db_file = ("--db" in argv and
+                         not argv[argv.index("--db") + 1].startswith("docstore://"))
+        if needs_db_file:
             argv[argv.index("--db") + 1] = f"/data/{db_file}"
             pod_spec["volumes"] = [{"name": "shared-db",
                                     "persistentVolumeClaim":
@@ -254,7 +274,7 @@ def render_k8s(inv: dict, outdir: str) -> None:
                      "command": argv,
                      "env": [{"name": k, "value": v}
                              for k, v in _config_env(inv).items()]}
-        if "--db" in argv:
+        if needs_db_file:
             container["volumeMounts"] = [{"name": "shared-db",
                                           "mountPath": "/data"}]
         docs.append({"apiVersion": "apps/v1", "kind": "Deployment",

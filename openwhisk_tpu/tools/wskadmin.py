@@ -17,7 +17,7 @@ import sys
 
 from ..core.entity import (BasicAuthenticationAuthKey, EntityName, Identity,
                            Namespace, Subject, UserLimits, UUID, WhiskAuthRecord)
-from ..database import AuthStore, SqliteArtifactStore
+from ..database import AuthStore, open_store
 
 
 async def _user_create(store: AuthStore, args) -> int:
@@ -170,7 +170,7 @@ def main(argv=None) -> int:
     dg.add_argument("--limit", type=int, default=100)
 
     args = parser.parse_args(argv)
-    raw = SqliteArtifactStore(args.db)
+    raw = open_store(args.db)  # sqlite path or docstore:// URL
     auth = AuthStore(raw)
 
     async def run():

@@ -159,3 +159,46 @@ class TestBinaryActions:
         st, body = _post(base, "/init",
                          {"value": {"code": code, "binary": True}})
         assert st == 502 and "escapes" in body["error"]
+
+
+def test_init_gate_waits_for_inflight_runs_and_blocks_new_ones():
+    """ThreadingHTTPServer serves /run concurrently; a re-init must drain
+    in-flight runs before evicting the old zip, and block new runs until
+    the new code is installed."""
+    import threading
+    import time
+
+    from openwhisk_tpu.containerpool.actionproxy import _InitRunGate
+
+    gate = _InitRunGate()
+    order = []
+
+    def runner():
+        gate.begin_run()
+        order.append("run-start")
+        time.sleep(0.15)
+        order.append("run-end")
+        gate.end_run()
+
+    def initer():
+        time.sleep(0.05)  # let the run start first
+        gate.begin_init()
+        order.append("init-start")
+        time.sleep(0.05)
+        order.append("init-end")
+        gate.end_init()
+
+    def late_runner():
+        time.sleep(0.1)  # arrives while init is waiting/active
+        gate.begin_run()
+        order.append("late-run")
+        gate.end_run()
+
+    threads = [threading.Thread(target=f)
+               for f in (runner, initer, late_runner)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert order.index("run-end") < order.index("init-start")
+    assert order.index("init-end") < order.index("late-run")

@@ -29,10 +29,39 @@ def make_stores():
             ("sqlite", lambda: SqliteArtifactStore(tmp))]
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+class _RemoteStoreFixture:
+    """Runs a DocStoreServer + RemoteArtifactStore inside whichever event
+    loop the test body uses (each test calls asyncio.run afresh), backed by
+    one durable sqlite file across loops."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._loop = None
+        self._client = None
+
+    async def _store(self):
+        from openwhisk_tpu.database import DocStoreServer, RemoteArtifactStore
+        loop = asyncio.get_running_loop()
+        if self._loop is not loop:
+            server = DocStoreServer(SqliteArtifactStore(self._path), port=0)
+            await server.start()
+            port = server._server.sockets[0].getsockname()[1]
+            self._client = RemoteArtifactStore("127.0.0.1", port)
+            self._loop = loop
+        return self._client
+
+    def __getattr__(self, name):
+        async def call(*args, **kwargs):
+            return await getattr(await self._store(), name)(*args, **kwargs)
+        return call
+
+
+@pytest.fixture(params=["memory", "sqlite", "remote"])
 def store(request, tmp_path):
     if request.param == "memory":
         return MemoryArtifactStore()
+    if request.param == "remote":
+        return _RemoteStoreFixture(str(tmp_path / "remote.db"))
     return SqliteArtifactStore(str(tmp_path / "whisks.db"))
 
 

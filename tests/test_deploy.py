@@ -45,6 +45,44 @@ class TestInventoryAndRenderers:
         i = ctrl[0]["argv"].index("--cluster-size")
         assert ctrl[0]["argv"][i + 1] == "2"
 
+    def test_docstore_topology(self):
+        """docstore enabled: the service joins the spine and every
+        controller/invoker dials docstore:// instead of opening a file."""
+        inv = deploy.load_inventory(None)
+        inv["docstore"]["enabled"] = True
+        inv["controllers"]["count"] = 2
+        inv["invokers"]["count"] = 2
+        svcs = deploy.services(inv)
+        names = [s["name"] for s in svcs]
+        assert names[:2] == ["bus", "docstore"]
+        ds = svcs[1]["argv"]
+        assert ds[ds.index("--db") + 1] == inv["db"]  # file stays server-side
+        for s in svcs:
+            if s["name"].startswith(("controller", "invoker")):
+                db = s["argv"][s["argv"].index("--db") + 1]
+                assert db == "docstore://127.0.0.1:4223"
+
+    def test_render_k8s_docstore_mode(self, tmp_path):
+        """URL-mode pods need no shared PVC; only the docstore mounts it."""
+        inv = deploy.load_inventory(None)
+        inv["docstore"]["enabled"] = True
+        deploy.render_k8s(inv, str(tmp_path))
+        docs = list(yaml.safe_load_all(
+            (tmp_path / "openwhisk-tpu.yaml").read_text()))
+        deployments = {d["metadata"]["name"]: d for d in docs
+                       if d["kind"] == "Deployment"}
+        assert "ow-docstore" in deployments
+        dsc = deployments["ow-docstore"]["spec"]["template"]["spec"]
+        assert dsc["containers"][0]["volumeMounts"][0]["mountPath"] == "/data"
+        for nm in ("ow-controller0", "ow-invoker0"):
+            c = deployments[nm]["spec"]["template"]["spec"]["containers"][0]
+            assert "volumeMounts" not in c
+            db = c["command"][c["command"].index("--db") + 1]
+            assert db == "docstore://ow-docstore:4223"
+        svc_names = [d["metadata"]["name"] for d in docs
+                     if d["kind"] == "Service"]
+        assert "ow-docstore" in svc_names
+
     def test_render_systemd(self, tmp_path):
         inv = deploy.load_inventory(None)
         deploy.render_systemd(inv, str(tmp_path))
@@ -144,6 +182,72 @@ class TestLocalUp:
             assert deploy.status(inv)
             status, body = asyncio.run(drive())
             assert (status, body) == (200, {"deployed": True})
+        finally:
+            deploy.down(inv)
+            os.chdir(cwd)
+        assert deploy._pids(inv) == []
+
+    def test_up_multihost_docstore_two_controllers_two_invokers(self, tmp_path):
+        """The VERDICT's multi-host acceptance: 2 controllers + 2 invokers
+        with NO shared sqlite file — every service reaches entities through
+        the docstore — serve an invoke end-to-end through the edge."""
+        import asyncio
+
+        import aiohttp
+
+        inv = deploy.load_inventory(None)
+        inv["rundir"] = str(tmp_path / "run")
+        inv["db"] = str(tmp_path / "docstore-only" / "whisks.db")
+        os.makedirs(os.path.dirname(inv["db"]), exist_ok=True)
+        inv["bus"]["port"] = 14223
+        inv["docstore"].update(enabled=True, port=14233)
+        inv["controllers"].update(count=2, base_port=13341, balancer="tpu")
+        inv["invokers"]["count"] = 2
+        inv["edge"]["port"] = 13882
+        os.environ.setdefault("PYTHONPATH", REPO)
+        cwd = os.getcwd()
+        os.chdir(REPO)
+        try:
+            deploy.up(inv)
+            from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID
+            auth = "Basic " + base64.b64encode(
+                f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+            hdrs = {"Authorization": auth, "Content-Type": "application/json"}
+            base = "http://127.0.0.1:13882/api/v1"  # through the edge
+
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    for _ in range(180):
+                        try:
+                            async with s.get("http://127.0.0.1:13341/invokers",
+                                             headers=hdrs) as r:
+                                body = await r.text()
+                                if r.status == 200 and body.count("up") >= 2:
+                                    break
+                        except aiohttp.ClientError:
+                            pass
+                        await asyncio.sleep(0.5)
+                    else:
+                        raise AssertionError("fleet never became healthy")
+                    async with s.put(f"{base}/namespaces/_/actions/mh",
+                                     headers=hdrs,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": "def main(a):\n    return {'multihost': True}"}}) as r:
+                        assert r.status == 200, await r.text()
+                    # both controllers must see the entity via the docstore
+                    for port in (13341, 13342):
+                        async with s.get(
+                                f"http://127.0.0.1:{port}/api/v1/namespaces/_/actions/mh",
+                                headers=hdrs) as r:
+                            assert r.status == 200, (port, await r.text())
+                    async with s.post(
+                            f"{base}/namespaces/_/actions/mh?blocking=true&result=true",
+                            headers=hdrs, json={}) as r:
+                        return r.status, await r.json()
+
+            assert deploy.status(inv)
+            status, body = asyncio.run(drive())
+            assert (status, body) == (200, {"multihost": True})
         finally:
             deploy.down(inv)
             os.chdir(cwd)

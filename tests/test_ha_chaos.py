@@ -37,9 +37,13 @@ class Cluster:
     """Popen-based mini-deployment with per-service kill/restart."""
 
     def __init__(self, tmp_path, n_controllers=1, edge=False, ctrl_env=None,
-                 balancer="sharding"):
+                 balancer="sharding", docstore=False):
         self.balancer = balancer
-        self.db = str(tmp_path / "whisks.db")
+        self.db_file = str(tmp_path / "whisks.db")
+        self.docstore_port = _free_port() if docstore else None
+        # with a docstore, services dial it; without, they share the file
+        self.db = (f"docstore://127.0.0.1:{self.docstore_port}"
+                   if docstore else self.db_file)
         self.bus_port = _free_port()
         self.ctrl_ports = [_free_port() for _ in range(n_controllers)]
         self.edge_port = _free_port() if edge else None
@@ -54,6 +58,8 @@ class Cluster:
     def start(self):
         self.spawn("bus", [sys.executable, "-m", "openwhisk_tpu.messaging",
                            "--port", str(self.bus_port)])
+        if self.docstore_port:
+            self.start_docstore()
         time.sleep(1.5)
         self.start_invoker()
         for i, port in enumerate(self.ctrl_ports):
@@ -70,6 +76,12 @@ class Cluster:
                                 "--port", str(self.edge_port), "--controllers",
                                 *[f"http://127.0.0.1:{p}"
                                   for p in self.ctrl_ports]])
+
+    def start_docstore(self):
+        self.spawn("docstore", [sys.executable, "-m",
+                                "openwhisk_tpu.database.remote_store",
+                                "--db", self.db_file,
+                                "--port", str(self.docstore_port)])
 
     def start_invoker(self, name="chaos-a"):
         self.spawn("invoker", [sys.executable, "-m", "openwhisk_tpu.invoker",
@@ -152,6 +164,60 @@ class TestControllerFailover:
 
             ok = asyncio.run(drive())
             assert ok >= 8, f"only {ok}/12 invokes survived controller kill"
+        finally:
+            cluster.stop()
+
+
+@pytest.mark.slow
+class TestDocstoreFailover:
+    def test_docstore_restart_traffic_resumes_entities_survive(self, tmp_path):
+        """ref ha/ShootComponentsTests:314-315 (CouchDB restart): kill the
+        shared doc-store mid-traffic; after a restart on the same backing
+        file, clients reconnect, entities survive, invokes succeed again."""
+        cluster = Cluster(tmp_path, n_controllers=1, docstore=True)
+        cluster.start()
+        try:
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    assert await cluster.wait_healthy(s)
+                    base = cluster.api()
+                    async with s.put(f"{base}/namespaces/_/actions/ds",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": CODE}}) as r:
+                        assert r.status == 200, await r.text()
+
+                    async def invoke(n):
+                        async with s.post(
+                                f"{base}/namespaces/_/actions/ds?blocking=true&result=true",
+                                headers=HDRS, json={"n": n}) as r:
+                            return r.status, await r.json(content_type=None)
+
+                    status, body = await invoke(1)
+                    assert status == 200 and body == {"alive": True, "n": 1}
+
+                    cluster.kill("docstore")
+                    cluster.start_docstore()
+                    # clients reconnect lazily on the next request; allow the
+                    # restart window, then require sustained success
+                    ok = 0
+                    for n in range(16):
+                        try:
+                            status, body = await invoke(100 + n)
+                            if status == 200 and body == {"alive": True,
+                                                          "n": 100 + n}:
+                                ok += 1
+                        except aiohttp.ClientError:
+                            pass
+                        await asyncio.sleep(0.25)
+                    # the entity itself must have survived the restart
+                    async with s.get(f"{base}/namespaces/_/actions/ds",
+                                     headers=HDRS) as r:
+                        return ok, r.status
+
+            ok, get_status = asyncio.run(drive())
+            assert ok >= 10, f"only {ok}/16 invokes after docstore restart"
+            assert get_status == 200
         finally:
             cluster.stop()
 

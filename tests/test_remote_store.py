@@ -1,0 +1,233 @@
+"""Networked ArtifactStore tests: DocStoreServer + RemoteArtifactStore
+(the CouchDbRestStore-equivalent seam; ref ArtifactStore.scala:41-150).
+
+Multi-host semantics the shared-sqlite-file deployment could not provide:
+distinct processes (here: distinct clients) sharing one revisioned
+document database over TCP."""
+import asyncio
+
+import pytest
+
+from openwhisk_tpu.core.entity import (CodeExec, EntityName, EntityPath,
+                                       Identity, WhiskAction, WhiskAuthRecord)
+from openwhisk_tpu.database import (AuthStore, DocStoreServer, DocumentConflict,
+                                    EntityStore, MemoryArtifactStore,
+                                    NoDocumentException, RemoteArtifactStore,
+                                    SqliteArtifactStore, open_store)
+from openwhisk_tpu.messaging.tcp import _frame, _read_frame
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _server(backing=None, port: int = 0):
+    srv = DocStoreServer(backing or MemoryArtifactStore(), port=port)
+    await srv.start()
+    return srv, srv._server.sockets[0].getsockname()[1]
+
+
+DOC = {"entityType": "actions", "namespace": "ns", "name": "a", "updated": 1}
+
+
+class TestSharedStoreAcrossClients:
+    def test_two_controllers_share_entities_and_conflicts(self):
+        """Client B sees client A's writes; stale-rev updates lose with
+        DocumentConflict exactly as on a local store."""
+        async def go():
+            srv, port = await _server()
+            a = RemoteArtifactStore("127.0.0.1", port)
+            b = RemoteArtifactStore("127.0.0.1", port)
+            rev1 = await a.put("ns/a", DOC)
+            got = await b.get("ns/a")
+            assert got["_rev"] == rev1
+            rev2 = await b.put("ns/a", dict(DOC, updated=2), rev=rev1)
+            with pytest.raises(DocumentConflict):
+                await a.put("ns/a", dict(DOC, updated=3), rev=rev1)
+            assert (await a.get("ns/a"))["_rev"] == rev2
+            with pytest.raises(NoDocumentException):
+                await b.get("ns/missing")
+            await a.close(); await b.close(); await srv.stop()
+        run(go())
+
+    def test_typed_entity_and_auth_stores_over_remote(self):
+        """The typed stores (EntityStore/AuthStore) run unchanged over the
+        remote client — the controller boot path for multi-host mode."""
+        async def go():
+            srv, port = await _server()
+            writer = EntityStore(RemoteArtifactStore("127.0.0.1", port))
+            reader = EntityStore(RemoteArtifactStore("127.0.0.1", port))
+            act = WhiskAction(EntityPath("ns"), EntityName("act"),
+                              CodeExec(kind="python:3", code="def main(a): return a"))
+            await writer.put(act)
+            got = await reader.get(WhiskAction, "ns/act", use_cache=False)
+            assert got.exec.code == act.exec.code
+
+            auth = AuthStore(RemoteArtifactStore("127.0.0.1", port))
+            ident = Identity.generate("shared-ns")
+            await auth.put(WhiskAuthRecord(ident.subject, [ident.namespace],
+                                           [ident.authkey]))
+            found = await auth.identity_by_key(ident.authkey.uuid.asString,
+                                               ident.authkey.key.asString)
+            assert found is not None
+            assert str(found.namespace.name) == "shared-ns"
+            await srv.stop()
+        run(go())
+
+    def test_attachments_round_trip(self):
+        async def go():
+            srv, port = await _server()
+            st = RemoteArtifactStore("127.0.0.1", port)
+            await st.put("ns/a", DOC)
+            blob = bytes(range(256)) * 64
+            await st.attach("ns/a", "code", "application/octet-stream", blob)
+            ct, data = await st.read_attachment("ns/a", "code")
+            assert ct == "application/octet-stream" and data == blob
+            await st.delete_attachments("ns/a")
+            with pytest.raises(NoDocumentException):
+                await st.read_attachment("ns/a", "code")
+            await st.close(); await srv.stop()
+        run(go())
+
+
+class TestEffectivelyOnceMutations:
+    def test_retried_put_frame_does_not_double_bump_revision(self):
+        """A put whose response frame was lost is retried with the same rid;
+        the server must replay the recorded response, not apply twice."""
+        async def go():
+            srv, port = await _server()
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            req = {"op": "put", "rid": "fixed-rid", "id": "ns/a", "doc": DOC,
+                   "rev": None}
+            writer.write(_frame(req)); await writer.drain()
+            first = await _read_frame(reader)
+            writer.write(_frame(req)); await writer.drain()  # the "retry"
+            second = await _read_frame(reader)
+            assert first == second
+            check = RemoteArtifactStore("127.0.0.1", port)
+            assert (await check.get("ns/a"))["_rev"] == first["rev"]
+            writer.close(); await check.close(); await srv.stop()
+        run(go())
+
+
+class TestDurabilityAndResolution:
+    def test_documents_survive_server_restart(self, tmp_path):
+        path = str(tmp_path / "whisks.db")
+
+        async def write():
+            srv, port = await _server(SqliteArtifactStore(path))
+            st = RemoteArtifactStore("127.0.0.1", port)
+            rev = await st.put("ns/a", DOC)
+            await st.close(); await srv.stop()
+            return rev
+
+        async def read():
+            srv, port = await _server(SqliteArtifactStore(path))
+            st = RemoteArtifactStore("127.0.0.1", port)
+            doc = await st.get("ns/a")
+            await st.close(); await srv.stop()
+            return doc
+
+        rev = run(write())
+        assert run(read())["_rev"] == rev
+
+    def test_open_store_url_resolution(self, tmp_path):
+        st = open_store("docstore://10.0.0.5:4223")
+        assert isinstance(st, RemoteArtifactStore)
+        assert (st.host, st.port) == ("10.0.0.5", 4223)
+        st2 = open_store(str(tmp_path / "local.db"))
+        assert isinstance(st2, SqliteArtifactStore)
+
+    def test_concurrent_clients_hammer_one_counter(self):
+        """N concurrent writers CAS-update one document; revision semantics
+        must serialize them into exactly N successful bumps."""
+        async def go():
+            srv, port = await _server()
+            async def bump(st):
+                while True:
+                    doc = await st.get("ns/ctr")
+                    body = {k: v for k, v in doc.items()
+                            if not k.startswith("_")}
+                    body["n"] = body.get("n", 0) + 1
+                    try:
+                        await st.put("ns/ctr", body, rev=doc["_rev"])
+                        return
+                    except DocumentConflict:
+                        await asyncio.sleep(0)
+            seed = RemoteArtifactStore("127.0.0.1", port)
+            await seed.put("ns/ctr", dict(DOC, name="ctr", n=0))
+            clients = [RemoteArtifactStore("127.0.0.1", port) for _ in range(8)]
+            await asyncio.gather(*[bump(c) for c in clients])
+            final = await seed.get("ns/ctr")
+            for c in clients:
+                await c.close()
+            await seed.close(); await srv.stop()
+            return final["n"], final["_rev"]
+        n, rev = run(go())
+        assert n == 8
+        assert rev.startswith("9-")  # 1 seed + 8 bumps
+
+
+class TestRestartRetryAmbiguity:
+    def test_retried_put_conflict_resolves_when_own_write_landed(self):
+        """Server restart eats the rid cache: a retried put that actually
+        applied comes back as a conflict — the client must recognize its
+        own stored body and return the committed revision."""
+        async def go():
+            st = RemoteArtifactStore("127.0.0.1", 1)  # never dialed
+
+            async def fake_request(obj):
+                if obj["op"] == "put":
+                    exc = DocumentConflict("conflict")
+                    exc.retried = True
+                    raise exc
+                assert obj["op"] == "get"
+                return {"doc": dict(DOC, _id="ns/a", _rev="1-abc")}
+
+            st._request = fake_request
+            assert await st.put("ns/a", dict(DOC)) == "1-abc"
+        run(go())
+
+    def test_retried_put_conflict_with_foreign_body_still_raises(self):
+        async def go():
+            st = RemoteArtifactStore("127.0.0.1", 1)
+
+            async def fake_request(obj):
+                if obj["op"] == "put":
+                    exc = DocumentConflict("conflict")
+                    exc.retried = True
+                    raise exc
+                return {"doc": dict(DOC, updated=999, _id="ns/a",
+                                    _rev="2-other")}
+
+            st._request = fake_request
+            with pytest.raises(DocumentConflict):
+                await st.put("ns/a", dict(DOC))
+        run(go())
+
+    def test_unretried_conflict_never_second_guessed(self):
+        async def go():
+            st = RemoteArtifactStore("127.0.0.1", 1)
+
+            async def fake_request(obj):
+                exc = DocumentConflict("conflict")
+                exc.retried = False
+                raise exc
+
+            st._request = fake_request
+            with pytest.raises(DocumentConflict):
+                await st.put("ns/a", dict(DOC))
+        run(go())
+
+    def test_retried_delete_no_document_treated_as_applied(self):
+        async def go():
+            st = RemoteArtifactStore("127.0.0.1", 1)
+
+            async def fake_request(obj):
+                exc = NoDocumentException("gone")
+                exc.retried = True
+                raise exc
+
+            st._request = fake_request
+            assert await st.delete("ns/a") is True
+        run(go())
