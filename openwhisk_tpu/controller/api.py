@@ -247,7 +247,11 @@ class ControllerApi:
 
     async def invokers(self, request):
         health = await self.c.load_balancer.invoker_health()
-        return web.json_response({h.id.as_string: h.status for h in health})
+        body = {h.id.as_string: h.status for h in health}
+        # observability for membership re-sharding ("/" keeps it disjoint
+        # from invoker ids, which never contain one)
+        body["cluster/size"] = self.c.load_balancer.cluster_size
+        return web.json_response(body)
 
     async def metrics(self, request):
         return web.Response(text=self.c.metrics.prometheus_text(),

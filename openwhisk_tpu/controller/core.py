@@ -19,6 +19,7 @@ from ..database import (ArtifactActivationStore, AuthStore, EntityStore,
                         RemoteCacheInvalidation)
 from ..utils.logging import Logging, MetricEmitter
 from .api import ControllerApi
+from .loadbalancer.base import LoadBalancer
 from .authentication import BasicAuthenticationProvider
 from .entitlement import LocalEntitlementProvider
 from .invoke import ActionInvoker
@@ -78,6 +79,7 @@ class Controller:
         self.route_manager = ApiRouteManager(store)
         self.api = ControllerApi(self)
         self._runner: Optional[web.AppRunner] = None
+        self.membership = None
         # resources an assembler (e.g. standalone) co-locates with this
         # controller; each must expose an async stop()
         self.owned_resources: list = []
@@ -117,6 +119,18 @@ class Controller:
             # system test action for probing unhealthy invokers
             # (ref InvokerPool.prepare, InvokerSupervision.scala:239-252)
             await self.load_balancer.prepare_health_test_action(self.entity_store)
+        lb_cls = type(self.load_balancer) if self.load_balancer else None
+        if lb_cls is not None and \
+                lb_cls.update_cluster is not LoadBalancer.update_cluster:
+            # clustering balancer: join the membership protocol so joins /
+            # crashes of peer controllers re-shard capacity at runtime
+            # (replaces Akka Cluster events,
+            # ShardingContainerPoolBalancer.scala:217-250)
+            from .loadbalancer.membership import ControllerMembership
+            self.membership = ControllerMembership(
+                self.provider, self.instance, self.load_balancer,
+                logger=self.logger)
+            self.membership.start()
         app = self.api.make_app()
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -128,6 +142,8 @@ class Controller:
     async def stop(self) -> None:
         if self._runner:
             await self._runner.cleanup()
+        if self.membership is not None:
+            await self.membership.stop()  # sends the graceful leave
         for resource in self.owned_resources:
             await resource.stop()
         if self.load_balancer is not None:

@@ -97,6 +97,11 @@ class LoadBalancer:
     def cluster_size(self) -> int:
         return 1
 
+    def update_cluster(self, cluster_size: int) -> None:
+        """Re-shard capacity on controller join/leave (ref updateCluster,
+        ShardingContainerPoolBalancer.scala:561-584). No-op for balancers
+        that never cluster (lean)."""
+
     async def invoker_health(self) -> List[InvokerHealth]:
         raise NotImplementedError
 

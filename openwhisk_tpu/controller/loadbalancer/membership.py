@@ -1,0 +1,162 @@
+"""Dynamic controller membership: live cluster size over the bus.
+
+The reference re-shards every invoker's memory between controllers using
+Akka Cluster membership events — MemberUp/MemberRemoved drive
+`updateCluster(availableMembers.size)`
+(ShardingContainerPoolBalancer.scala:217-250,561-584). This is the
+framework-native replacement: each controller heartbeats on a
+`controllers` topic; every controller folds the live set from heartbeat
+recency and calls `balancer.update_cluster(n_live)` whenever it changes,
+so capacity re-shards within a bounded window of a join or a crash. A
+graceful shutdown sends a `leave` so planned departures re-shard
+immediately instead of waiting out the timeout.
+
+The deploy-time `--cluster-size` remains the initial value (the
+reference's seed-node list); membership converges from there.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+from ...messaging.connector import MessageFeed
+from ...utils.scheduler import Scheduler
+from ...utils.transaction import TransactionId
+
+CONTROLLERS_TOPIC = "controllers"
+#: heartbeats are ephemeral like health pings — keep only a small tail
+CONTROLLERS_RETENTION_BYTES = 256 * 1024
+HEARTBEAT_S = 1.0
+#: a controller is gone after this much heartbeat silence (the reference's
+#: Akka failure detector defaults are in the same few-second range)
+MEMBER_TIMEOUT_S = 5.0
+
+
+class ControllerMembership:
+    def __init__(self, messaging_provider, instance, balancer, logger=None,
+                 heartbeat_s: float = HEARTBEAT_S,
+                 member_timeout_s: float = MEMBER_TIMEOUT_S):
+        self.provider = messaging_provider
+        self.instance = instance
+        self.balancer = balancer
+        self.logger = logger
+        self.heartbeat_s = heartbeat_s
+        self.member_timeout_s = member_timeout_s
+        #: instance -> local receive time of the last heartbeat
+        self._last_seen: Dict[int, float] = {}
+        self._producer = None
+        self._feed: Optional[MessageFeed] = None
+        self._ticker: Optional[Scheduler] = None
+        self._current_size = 0
+        self._seed_size = 1
+        self._started = 0.0
+        self._last_tick = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        # the deploy-time size seeds a grace window: until peers have had a
+        # full timeout to heartbeat, never fold BELOW the seed — otherwise a
+        # fresh controller booted as 1-of-2 would briefly claim the whole
+        # fleet's capacity and overcommit
+        self._seed_size = max(self.balancer.cluster_size, 1)
+        self._current_size = self._seed_size  # update only on real change
+        self._started = time.monotonic()
+        self.provider.ensure_topic(CONTROLLERS_TOPIC,
+                                   retention_bytes=CONTROLLERS_RETENTION_BYTES)
+        self._producer = self.provider.get_producer()
+        consumer = self.provider.get_consumer(
+            CONTROLLERS_TOPIC, f"membership{self.instance.instance}",
+            max_peek=128, from_latest=True)
+        box = {}
+
+        async def handle(payload: bytes):
+            self._on_message(payload)
+            box["feed"].processed()
+
+        self._feed = MessageFeed("controllers", consumer, 128, handle,
+                                 logger=self.logger)
+        box["feed"] = self._feed
+        self._feed.start()
+        self._ticker = Scheduler(self.heartbeat_s, self._tick,
+                                 name="membership-heartbeat",
+                                 logger=self.logger).start()
+
+    async def stop(self) -> None:
+        if self._ticker:
+            await self._ticker.stop()
+        if self._producer is not None:
+            try:  # planned departure: peers re-shard without the timeout
+                await self._producer.send(CONTROLLERS_TOPIC, json.dumps(
+                    {"kind": "leave",
+                     "instance": self.instance.instance}).encode())
+            except Exception:  # noqa: BLE001 — bus may already be gone
+                pass
+        if self._feed:
+            await self._feed.stop()
+
+    # -- protocol ----------------------------------------------------------
+    def _on_message(self, payload: bytes) -> None:
+        try:
+            msg = json.loads(payload)
+            inst = int(msg["instance"])
+            kind = msg.get("kind", "heartbeat")
+        except (ValueError, KeyError, TypeError):
+            return
+        if inst == self.instance.instance:
+            return
+        if kind == "leave":
+            self._last_seen.pop(inst, None)
+            self._refold()
+        else:
+            joined = inst not in self._last_seen
+            self._last_seen[inst] = time.monotonic()
+            if joined:
+                self._refold()
+
+    async def _tick(self) -> None:
+        await self._producer.send(CONTROLLERS_TOPIC, json.dumps(
+            {"kind": "heartbeat", "instance": self.instance.instance}).encode())
+        now = time.monotonic()
+        # Stall guard: if OUR OWN ticks gapped (event loop blocked — e.g. a
+        # long jit compile — or host pause), peer silence is our fault, not
+        # theirs. Give every peer (and the boot grace window) a fresh
+        # heartbeat interval before judging, the same reason Akka's failure
+        # detector forgives process pauses.
+        if self._last_tick and now - self._last_tick > self.member_timeout_s:
+            stall = now - self._last_tick
+            self._started += stall
+            floor = now - self.heartbeat_s
+            self._last_seen = {i: max(ts, floor)
+                               for i, ts in self._last_seen.items()}
+        self._last_tick = now
+        dead = [i for i, ts in self._last_seen.items()
+                if now - ts > self.member_timeout_s]
+        for i in dead:
+            del self._last_seen[i]
+        # refold every tick: it no-ops when the size is unchanged, and also
+        # converges the case where a seeded peer never appeared at all once
+        # the boot grace window lapses
+        self._refold()
+
+    def _refold(self) -> None:
+        n = 1 + len(self._last_seen)  # self + live peers
+        if time.monotonic() - self._started < self.member_timeout_s:
+            n = max(n, self._seed_size)
+        if n != self._current_size:
+            old = self._current_size
+            self._current_size = n
+            if self.logger:
+                self.logger.info(
+                    TransactionId.LOADBALANCER,
+                    f"cluster membership {old or '?'} -> {n} "
+                    f"(peers: {sorted(self._last_seen)})", "Membership")
+            self.balancer.update_cluster(n)
+            metrics = getattr(self.balancer, "metrics", None)
+            if metrics is not None:
+                metrics.gauge("loadbalancer_cluster_size", n)
+
+    # -- views -------------------------------------------------------------
+    @property
+    def cluster_size(self) -> int:
+        return self._current_size or 1

@@ -37,6 +37,11 @@ class ShardingBalancer(CommonLoadBalancer):
         self.start_ack_feed()
         self.supervision.start()
 
+    def update_cluster(self, cluster_size: int) -> None:
+        """Controller joined/left: divide every invoker's memory by the new
+        cluster size (ref updateCluster :561-584)."""
+        self.policy.update_cluster(cluster_size)
+
     def _status_change(self, instance: InvokerInstanceId, status: str) -> None:
         # backfill gaps as UNUSABLE placeholders: invoker N's ping may arrive
         # before 0..N-1's (bus ordering race) and never-seen invokers must

@@ -1,0 +1,114 @@
+"""Dynamic controller membership tests (ref Akka Cluster events driving
+updateCluster, ShardingContainerPoolBalancer.scala:217-250,561-584)."""
+import asyncio
+
+from openwhisk_tpu.controller.loadbalancer.membership import ControllerMembership
+from openwhisk_tpu.core.entity import ControllerInstanceId
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+
+
+class BalancerStub:
+    def __init__(self, cluster_size=1):
+        self.cluster_size = cluster_size
+        self.calls = []
+
+    def update_cluster(self, n):
+        self.calls.append(n)
+        self.cluster_size = n
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make(provider, i, seed=1, heartbeat=0.05, timeout=0.25):
+    bal = BalancerStub(cluster_size=seed)
+    m = ControllerMembership(provider, ControllerInstanceId(str(i)), bal,
+                             heartbeat_s=heartbeat, member_timeout_s=timeout)
+    return m, bal
+
+
+async def until(cond, timeout=5.0, step=0.02):
+    for _ in range(int(timeout / step)):
+        if cond():
+            return True
+        await asyncio.sleep(step)
+    return cond()
+
+
+class TestMembershipConvergence:
+    def test_two_controllers_converge_to_two(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            m0, b0 = make(provider, 0)
+            m1, b1 = make(provider, 1)
+            m0.start(); m1.start()
+            ok = await until(lambda: b0.cluster_size == 2 and
+                             b1.cluster_size == 2)
+            await m0.stop(); await m1.stop()
+            return ok, b0.calls, b1.calls
+        ok, c0, c1 = run(go())
+        assert ok, (c0, c1)
+
+    def test_graceful_leave_reshards_immediately(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            m0, b0 = make(provider, 0)
+            m1, b1 = make(provider, 1)
+            m0.start(); m1.start()
+            assert await until(lambda: b0.cluster_size == 2)
+            await m1.stop()  # graceful: sends the leave message
+            # well inside the heartbeat timeout: leave acts immediately
+            ok = await until(lambda: b0.cluster_size == 1, timeout=0.2)
+            await m0.stop()
+            return ok
+        assert run(go())
+
+    def test_crash_reshards_after_timeout(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            m0, b0 = make(provider, 0)
+            m1, b1 = make(provider, 1)
+            m0.start(); m1.start()
+            assert await until(lambda: b0.cluster_size == 2)
+            # crash: silence the heartbeats without a leave
+            await m1._ticker.stop()
+            await m1._feed.stop()
+            ok = await until(lambda: b0.cluster_size == 1, timeout=3.0)
+            await m0.stop()
+            return ok
+        assert run(go())
+
+    def test_boot_grace_respects_seed_size(self):
+        """A 1-of-2 controller must not claim the whole fleet before its
+        peer had a chance to heartbeat; after the grace window with no peer
+        it converges down."""
+        async def go():
+            provider = MemoryMessagingProvider()
+            m0, b0 = make(provider, 0, seed=2, timeout=0.4)
+            m0.start()
+            await asyncio.sleep(0.15)  # inside the grace window
+            held = b0.cluster_size == 2 and b0.calls == []
+            ok = await until(lambda: b0.cluster_size == 1, timeout=3.0)
+            await m0.stop()
+            return held, ok
+        held, ok = run(go())
+        assert held, "folded below the seed size during the boot grace"
+        assert ok, "never converged after the grace window"
+
+    def test_rejoin_after_crash_recovers_size(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            m0, b0 = make(provider, 0)
+            m1, b1 = make(provider, 1)
+            m0.start(); m1.start()
+            assert await until(lambda: b0.cluster_size == 2)
+            await m1._ticker.stop(); await m1._feed.stop()
+            assert await until(lambda: b0.cluster_size == 1, timeout=3.0)
+            m2, b2 = make(provider, 1)  # restart of controller1
+            m2.start()
+            ok = await until(lambda: b0.cluster_size == 2 and
+                             b2.cluster_size == 2)
+            await m0.stop(); await m2.stop()
+            return ok
+        assert run(go())
