@@ -75,6 +75,10 @@ class ActivationEntry:
     timeout_task: Optional[asyncio.Task] = None
     promise: Optional[asyncio.Future] = None
     forced: bool = False
+    #: TPU balancer only: the device concurrency slot this activation's
+    #: acquire returned, so its release lands on exactly that slot even if
+    #: the action's key->slot mapping migrates while it is in flight
+    conc_slot: Optional[int] = None
 
 
 class LoadBalancer:

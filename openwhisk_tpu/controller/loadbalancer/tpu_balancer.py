@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+import zlib
 from typing import Dict, List, Optional
 
 import jax
@@ -66,32 +67,91 @@ def _mod_inverse(step: int, m: int) -> int:
 class _SlotAllocator:
     """Host-side collision-free action->concurrency-slot mapping (the inner
     NestedSemaphore level is dense on device; slots recycle when no
-    in-flight activation references them)."""
+    in-flight activation references them).
+
+    Saturation: the balancer grows the slot axis before this allocator ever
+    runs dry (see TpuBalancer._ensure_slot_capacity); only past the hard cap
+    does a key land in `overflow` — a stable CRC32-hashed slot (restart-safe,
+    unlike builtin hash() under PYTHONHASHSEED) shared with whatever
+    dedicated key owns it, refcounted so release stays balanced, and counted
+    by the saturation metric so conflated pools are never silent."""
 
     def __init__(self, n_slots: int):
         self.n_slots = n_slots
         self.slots: Dict[str, int] = {}
         self.refcount: Dict[str, int] = {}
         self.free: List[int] = list(range(n_slots - 1, -1, -1))
+        #: key -> [slot, refcount]; the slot is pinned at first acquire so
+        #: every in-flight activation of the key releases the slot it took,
+        #: even if n_slots grows (which would move the CRC32 residue)
+        self.overflow: Dict[str, List[int]] = {}
+
+    def _stable_slot(self, key: str) -> int:
+        return zlib.crc32(key.encode()) % self.n_slots
+
+    @property
+    def saturated(self) -> bool:
+        return not self.free
+
+    def needs_slot(self, key: str) -> bool:
+        """Would acquiring `key` want a slot it doesn't own? (Overflowed keys
+        count: their next acquire migrates to a dedicated slot if one is
+        free.)"""
+        return key not in self.slots
 
     def acquire(self, key: str) -> int:
+        of = self.overflow.get(key)
+        if of is not None and not self.free and key not in self.slots:
+            of[1] += 1  # still capped: pile on the pinned shared slot
+            return of[0]
         if key not in self.slots:
             if not self.free:
-                # saturated: fall back to hashing (collisions conflate pools)
-                return hash(key) % self.n_slots
+                slot = self._stable_slot(key)
+                self.overflow[key] = [slot, 1]
+                return slot
+            # fresh key — or an overflowed key migrating now that capacity
+            # freed (its old in-flight releases still land on the pinned
+            # slot: every release carries the slot its acquire returned)
             self.slots[key] = self.free.pop()
         self.refcount[key] = self.refcount.get(key, 0) + 1
         return self.slots[key]
 
-    def release(self, key: str) -> None:
+    def lookup(self, key: str) -> int:
+        """Best-effort slot for `key` (fallback when a release arrives
+        without its acquire-time slot, e.g. after a pre-upgrade snapshot)."""
+        slot = self.slots.get(key)
+        if slot is not None:
+            return slot
+        of = self.overflow.get(key)
+        return of[0] if of is not None else self._stable_slot(key)
+
+    def release(self, key: str, slot: Optional[int] = None) -> None:
+        """Balance the acquire that returned `slot` (None = best guess)."""
+        ded = self.slots.get(key)
+        of = self.overflow.get(key)
+        use_dedicated = (ded is not None and self.refcount.get(key, 0) > 0
+                         and (slot is None or slot == ded or of is None))
+        if not use_dedicated and of is not None:
+            of[1] -= 1
+            if of[1] <= 0:
+                self.overflow.pop(key)
+            return
         n = self.refcount.get(key, 0) - 1
         if n <= 0:
             self.refcount.pop(key, None)
-            slot = self.slots.pop(key, None)
-            if slot is not None:
-                self.free.append(slot)
+            s = self.slots.pop(key, None)
+            if s is not None:
+                self.free.append(s)
         else:
             self.refcount[key] = n
+
+    def grow(self, new_n: int) -> None:
+        """Extend the slot axis (the balancer grew the device array to
+        match). Existing assignments — including pinned overflow slots —
+        stay put; only fresh capacity is added."""
+        assert new_n > self.n_slots
+        self.free = list(range(new_n - 1, self.n_slots - 1, -1)) + self.free
+        self.n_slots = new_n
 
 
 class TpuBalancer(CommonLoadBalancer):
@@ -99,8 +159,8 @@ class TpuBalancer(CommonLoadBalancer):
                  metrics=None, cluster_size: int = 1,
                  managed_fraction: float = 0.9, blackbox_fraction: float = 0.1,
                  batch_window: float = 0.002, max_batch: int = 256,
-                 action_slots: int = 4096, initial_pad: int = 64,
-                 mesh=None, kernel: str = "xla"):
+                 action_slots: int = 4096, max_action_slots: int = 65536,
+                 initial_pad: int = 64, mesh=None, kernel: str = "xla"):
         super().__init__(messaging_provider, controller_instance, logger, metrics)
         self._cluster_size = cluster_size
         self.kernel = kernel  # "xla" | "pallas" (single-device only)
@@ -109,6 +169,7 @@ class TpuBalancer(CommonLoadBalancer):
         self.batch_window = batch_window
         self.max_batch = max_batch
         self.action_slots = action_slots
+        self.max_action_slots = max(max_action_slots, action_slots)
         self.mesh = mesh
         self._n_pad = max(initial_pad, (mesh and np.prod(list(mesh.shape.values()))) or 1)
 
@@ -241,14 +302,53 @@ class TpuBalancer(CommonLoadBalancer):
         health = np.zeros((new_pad,), bool)
         health[:n_old] = old_health
         self._n_pad = new_pad
-        state = PlacementState(jnp.asarray(free), jnp.asarray(conc),
-                               jnp.asarray(health))
+        self._install_state(PlacementState(jnp.asarray(free),
+                                           jnp.asarray(conc),
+                                           jnp.asarray(health)))
+
+    def _ensure_slot_capacity(self, slot_key: str) -> None:
+        """Grow the concurrency-slot axis before the allocator runs dry, the
+        same way _grow_padding grows the invoker axis. Past the hard cap the
+        allocator's stable-hash overflow takes over — counted and warned, so
+        conflated concurrency pools are never silent."""
+        if not (self._slots.saturated and self._slots.needs_slot(slot_key)):
+            return
+        if self.action_slots < self.max_action_slots:
+            self._grow_slots(min(self.action_slots * 2, self.max_action_slots))
+        else:
+            # counted on EVERY overflowed acquire, so sustained conflation
+            # shows up as a climbing rate, not a one-off blip
+            self.metrics.counter("loadbalancer_action_slot_overflow")
+            if self.logger and slot_key not in self._slots.overflow:
+                self.logger.warn(
+                    None, f"action concurrency slots saturated at the hard "
+                    f"cap ({self.action_slots}); '{slot_key}' shares a "
+                    "hashed slot (conflated concurrency pool)")
+
+    def _install_state(self, state: PlacementState) -> None:
+        """Adopt new-shape device arrays: shard onto the mesh (if any) and
+        drop pallas if the shapes outgrew its VMEM budget."""
         if self.mesh is not None:
             from ...parallel.sharded_state import shard_state
             state = shard_state(state, self.mesh)
         self.state = state
         if self.kernel == "pallas" and not self._pallas_fits():
             self._use_xla_kernels()
+
+    def _grow_slots(self, new_slots: int) -> None:
+        """Widen conc_free's action axis, preserving every live permit."""
+        old_conc = np.asarray(self.state.conc_free)
+        conc = np.zeros((old_conc.shape[0], new_slots), np.int32)
+        conc[:, : old_conc.shape[1]] = old_conc
+        self.action_slots = new_slots
+        self._slots.grow(new_slots)
+        self._install_state(PlacementState(self.state.free_mb,
+                                           jnp.asarray(conc),
+                                           self.state.health))
+        self.metrics.counter("loadbalancer_action_slot_growth")
+        if self.logger:
+            self.logger.info(
+                None, f"grew action concurrency slots to {new_slots}")
 
     def _recompute_partitions(self) -> None:
         n = len(self._registry)
@@ -279,8 +379,8 @@ class TpuBalancer(CommonLoadBalancer):
             self._flush_task.cancel()
         # fail queued publishers instead of leaving them awaiting forever
         pending, self._pending = self._pending, []
-        for _, fut, slot_key in pending:
-            self._slots.release(slot_key)
+        for req, fut, slot_key in pending:
+            self._slots.release(slot_key, req["conc_slot"])
             if not fut.done():
                 fut.set_exception(LoadBalancerException("load balancer shut down"))
         self._releases.clear()
@@ -305,6 +405,7 @@ class TpuBalancer(CommonLoadBalancer):
         mem = action.limits.memory.megabytes
         maxc = action.limits.concurrency.max_concurrent
         slot_key = f"{action.fully_qualified_name}:{mem}"
+        self._ensure_slot_capacity(slot_key)
         req = {
             "offset": offset, "size": size, "home": h % size,
             "step_inv": _mod_inverse(step, size), "need_mb": mem,
@@ -316,13 +417,16 @@ class TpuBalancer(CommonLoadBalancer):
         self._arm_flush(urgent=len(self._pending) >= self.max_batch)
         inv_idx, forced = await fut
         if inv_idx < 0:
-            self._slots.release(slot_key)
+            self._slots.release(slot_key, req["conc_slot"])
             raise LoadBalancerException(
                 "No invokers available to schedule the activation.")
         if forced:
             self.metrics.counter("loadbalancer_forced_placements")
         invoker = self._registry[inv_idx]
         promise = self.setup_activation(msg, action, invoker)
+        entry = self.activation_slots.get(msg.activation_id.asString)
+        if entry is not None:
+            entry.conc_slot = req["conc_slot"]
         await self.send_activation_to_invoker(msg, invoker)
         return promise
 
@@ -330,9 +434,8 @@ class TpuBalancer(CommonLoadBalancer):
     def release_invoker(self, invoker: InvokerInstanceId, entry) -> None:
         action_name = entry.action_key.rsplit("@", 1)[0]
         key = f"{action_name}:{entry.memory_mb}"
-        slot = self._slots.slots.get(key)
-        if slot is None:
-            slot = hash(key) % self.action_slots
+        slot = (entry.conc_slot if entry.conc_slot is not None
+                else self._slots.lookup(key))
         self._releases.append((invoker.instance, slot, entry.memory_mb,
                                entry.max_concurrent, key))
         self._arm_flush()
@@ -354,6 +457,7 @@ class TpuBalancer(CommonLoadBalancer):
         return {
             "n_pad": self._n_pad,
             "cluster_size": self._cluster_size,
+            "action_slots": self.action_slots,
             "registry": [inv.to_json() for inv in self._registry],
             "healthy": list(self._healthy),
             "free_mb": np.asarray(self.state.free_mb).tolist(),
@@ -361,11 +465,15 @@ class TpuBalancer(CommonLoadBalancer):
                              for i, j in zip(*nz)],
             "slots": dict(self._slots.slots),
             "slot_refcount": dict(self._slots.refcount),
+            "slot_overflow": {k: list(v)
+                              for k, v in self._slots.overflow.items()},
         }
 
     def restore(self, snap: dict) -> None:
         self._n_pad = int(snap["n_pad"])
         self._cluster_size = int(snap["cluster_size"])
+        # older snapshots predate the growable slot axis
+        self.action_slots = int(snap.get("action_slots", self.action_slots))
         self._registry = [InvokerInstanceId.from_json(j)
                           for j in snap["registry"]]
         self._healthy = [bool(h) for h in snap["healthy"]]
@@ -375,21 +483,18 @@ class TpuBalancer(CommonLoadBalancer):
             conc[i, j] = v
         health = np.zeros((self._n_pad,), bool)
         health[: len(self._healthy)] = self._healthy
-        state = PlacementState(jnp.asarray(free), jnp.asarray(conc),
-                               jnp.asarray(health))
-        if self.mesh is not None:
-            from ...parallel.sharded_state import shard_state
-            state = shard_state(state, self.mesh)
-        self.state = state
+        self._install_state(PlacementState(jnp.asarray(free),
+                                           jnp.asarray(conc),
+                                           jnp.asarray(health)))
+        self._slots.n_slots = self.action_slots
         self._slots.slots = dict(snap.get("slots", {}))
         self._slots.refcount = dict(snap.get("slot_refcount", {}))
+        self._slots.overflow = {k: [int(v[0]), int(v[1])]
+                                for k, v in snap.get("slot_overflow", {}).items()}
         used = set(self._slots.slots.values())
         self._slots.free = [s for s in range(self.action_slots - 1, -1, -1)
                             if s not in used]
         self._recompute_partitions()
-        if self.kernel == "pallas" and not self._pallas_fits():
-            # snapshot may carry an _n_pad past the pallas VMEM budget
-            self._use_xla_kernels()
 
     # -- the device step ---------------------------------------------------
     def _arm_flush(self, urgent: bool = False) -> None:
@@ -438,7 +543,7 @@ class TpuBalancer(CommonLoadBalancer):
             jnp.asarray([r[3] for r in rel] + [1] * pad, jnp.int32),
             jnp.asarray([True] * len(rel) + [False] * pad, bool))
         for r in rel:
-            self._slots.release(r[4])
+            self._slots.release(r[4], r[1])
         return arrays
 
     def _health_arrays(self):

@@ -7,15 +7,20 @@ time (pytest imports conftest before test modules).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, not setdefault: the driver/judge environment exports
+# JAX_PLATFORMS=axon (the TPU tunnel), and subprocesses spawned by tests
+# inherit os.environ — a setdefault would leave them contending for the
+# one tunneled chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon TPU plugin ignores the JAX_PLATFORMS env var; force the CPU
-# backend through the config API so tests never touch the tunneled chip.
+# Belt and suspenders for the pytest process itself (env var above covers
+# spawned subprocesses; this covers the case where jax was imported before
+# conftest in an embedding process).
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

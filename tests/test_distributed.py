@@ -239,7 +239,7 @@ class TestMultiProcessDeployment:
         bus_port = _free_port()
         api_port = _free_port()
         db = str(tmp_path / "whisks.db")
-        env = dict(os.environ, PYTHONPATH=REPO)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
         procs = []
         try:
             procs.append(subprocess.Popen(

@@ -47,7 +47,11 @@ class Cluster:
         self.bus_port = _free_port()
         self.ctrl_ports = [_free_port() for _ in range(n_controllers)]
         self.edge_port = _free_port() if edge else None
-        self.env = dict(os.environ, PYTHONPATH=REPO, **(ctrl_env or {}))
+        # Pin spawned services to the CPU backend regardless of what the
+        # caller's environment says (the driver exports JAX_PLATFORMS=axon,
+        # under which multiple TPU controllers would contend for one chip).
+        self.env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        self.env.update(ctrl_env or {})
         self.procs = {}
 
     def spawn(self, name, argv):
