@@ -7,16 +7,38 @@ program TpuBalancer._device_step dispatches per micro-batch. Books are held
 constant (each step releases the prior step's placements) so the loop runs
 indefinitely.
 
+What runs (default, no args):
+  1. XLA kernel, median of 5 timed repeats (+ spread) — the headline number.
+  2. Pallas kernel (ops/placement_pallas.py), same protocol — on real TPU
+     hardware this is the compiled kernel, on CPU it is interpret mode.
+  3. On-device parity: both kernels stepped from identical state over the
+     same batch; chosen/forced/books compared exactly.
+  4. Balancer-level benchmark: TpuBalancer.publish() -> placement future,
+     echo invokers on the in-memory bus — activations/s and p50/p99
+     publish->placement latency at the default batch window (the host-side
+     batch assembly + asyncio + promise fan-out the device number omits).
+
+`--kernel xla|pallas` restricts step 1-2 to one kernel; `--quick` skips the
+balancer bench; `--sweep` prints an (N invokers x A slots) xla-vs-pallas
+rate table to stderr for kernel-selection docs — sweep mode emits NO JSON
+line on stdout and ignores --kernel/--quick (it is a diagnostic, not the
+driver contract).
+
 Baseline: BASELINE.json targets >= 50,000 placements/s (reference point: the
 CPU ShardingContainerPoolBalancer inner loop, which this kernel replaces).
-`vs_baseline` = measured rate / 50,000. A CPU-oracle rate is also measured
+`vs_baseline` = median XLA rate / 50,000. A CPU-oracle rate is also measured
 for context (stderr).
 
-Prints ONE JSON line on stdout.
+Prints ONE JSON line on stdout; every secondary figure rides along as extra
+keys (kernels, parity_ok, balancer, spread) so the driver's BENCH_r{N}.json
+captures the whole story.
 """
 from __future__ import annotations
 
+import argparse
+import asyncio
 import json
+import statistics
 import sys
 import time
 
@@ -26,23 +48,47 @@ N_INVOKERS = 1024
 BATCH = 256
 WARMUP = 5
 ITERS = 40
+REPEATS = 5
 TARGET = 50_000.0
 
 
-def main() -> None:
+def _build_fused(kernel: str):
+    """The balancer's fused device program with the requested schedule
+    kernel — mirrors TpuBalancer._init_device_state's wrapping."""
+    import jax
+
+    from openwhisk_tpu.ops.placement import (PlacementState, make_fused_step,
+                                             schedule_batch)
+
+    if kernel == "pallas":
+        from openwhisk_tpu.ops.placement_pallas import (schedule_batch_pallas,
+                                                        to_transposed)
+        interpret = jax.default_backend() == "cpu"
+
+        def sched(st, b):
+            ts, chosen, forced = schedule_batch_pallas(
+                to_transposed(st), b, interpret=interpret)
+            return (PlacementState(ts.free_mb, ts.conc_free.T, ts.health),
+                    chosen, forced)
+
+        return make_fused_step(None, sched)
+    return make_fused_step(None, schedule_batch)
+
+
+def _bench_kernel(kernel: str, n_invokers: int = N_INVOKERS,
+                  action_slots: int = 256, repeats: int = REPEATS,
+                  iters: int = ITERS) -> dict:
+    """Median-of-`repeats` steady-state rate for one kernel."""
     import jax
     import jax.numpy as jnp
 
     from __graft_entry__ import _example_batch
-    from openwhisk_tpu.ops.placement import init_state, make_fused_step
+    from openwhisk_tpu.ops.placement import init_state
 
-    state0 = init_state(N_INVOKERS, [2048] * N_INVOKERS, action_slots=256)
-    batch = _example_batch(N_INVOKERS, BATCH, seed=7)
-
-    # the balancer's actual device program: fold releases + health flips +
-    # schedule, compiled as ONE call (ops.placement.make_fused_step). The
-    # releases fed in are the previous batch's placements, books constant.
-    fused = make_fused_step()
+    state0 = init_state(n_invokers, [2048] * n_invokers,
+                        action_slots=action_slots)
+    batch = _example_batch(n_invokers, BATCH, seed=7)
+    fused = _build_fused(kernel)
     hidx = jnp.zeros((8,), jnp.int32)
     hval = jnp.zeros((8,), bool)
     hmask = jnp.zeros((8,), bool)
@@ -59,28 +105,176 @@ def main() -> None:
         carry, chosen = step(carry)
     jax.block_until_ready(carry)
 
-    lat = []
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        t1 = time.perf_counter()
-        carry, chosen = step(carry)
-        jax.block_until_ready(chosen)
-        lat.append(time.perf_counter() - t1)
-    dt = time.perf_counter() - t0
-    rate = BATCH * ITERS / dt
-    p50_ms = sorted(lat)[len(lat) // 2] * 1e3
+    rates, p50s = [], []
+    for _ in range(repeats):
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            t1 = time.perf_counter()
+            carry, chosen = step(carry)
+            jax.block_until_ready(chosen)
+            lat.append(time.perf_counter() - t1)
+        dt = time.perf_counter() - t0
+        rates.append(BATCH * iters / dt)
+        p50s.append(sorted(lat)[len(lat) // 2] * 1e3)
 
-    # CPU oracle context (the reference scheduling loop, same trace shape)
-    cpu_rate = _cpu_oracle_rate()
-    print(f"# device={jax.devices()[0]} p50_step={p50_ms:.2f}ms "
-          f"cpu_oracle={cpu_rate:.0f}/s", file=sys.stderr)
+    med = statistics.median(rates)
+    return {
+        "rate_median": round(med, 1),
+        "rate_min": round(min(rates), 1),
+        "rate_max": round(max(rates), 1),
+        "spread_pct": round(100.0 * (max(rates) - min(rates)) / med, 1),
+        "p50_step_ms": round(statistics.median(p50s), 3),
+        "repeats": repeats,
+    }
 
-    print(json.dumps({
-        "metric": "placements_per_sec",
-        "value": round(rate, 1),
-        "unit": "placements/s",
-        "vs_baseline": round(rate / TARGET, 3),
-    }))
+
+def _parity_check(n_invokers: int = 512, action_slots: int = 128) -> bool:
+    """Step the XLA and pallas kernels from identical state over the same
+    batch ON DEVICE and compare placements and books exactly."""
+    import numpy as np
+
+    from __graft_entry__ import _example_batch
+    from openwhisk_tpu.ops.placement import init_state
+
+    batch = _example_batch(n_invokers, BATCH, seed=11)
+    import jax.numpy as jnp
+    hidx = jnp.zeros((8,), jnp.int32)
+    hval = jnp.zeros((8,), bool)
+    hmask = jnp.zeros((8,), bool)
+    no_rel = jnp.zeros((BATCH,), bool)
+    rel_inv = jnp.zeros((BATCH,), jnp.int32)
+
+    outs = {}
+    for kernel in ("xla", "pallas"):
+        state = init_state(n_invokers, [2048] * n_invokers,
+                           action_slots=action_slots)
+        fused = _build_fused(kernel)
+        # two steps: the second exercises release-fold + scheduling on
+        # non-trivial books
+        state, chosen1, forced1 = fused(
+            state, rel_inv, batch.conc_slot, batch.need_mb, batch.max_conc,
+            no_rel, hidx, hval, hmask, batch)
+        state, chosen2, forced2 = fused(
+            state, jnp.clip(chosen1, 0), batch.conc_slot, batch.need_mb,
+            batch.max_conc, chosen1 >= 0, hidx, hval, hmask, batch)
+        outs[kernel] = tuple(np.asarray(x) for x in
+                             (chosen1, forced1, chosen2, forced2,
+                              state.free_mb, state.conc_free, state.health))
+
+    ok = all(np.array_equal(a, b) for a, b in zip(outs["xla"], outs["pallas"]))
+    if not ok:
+        for i, name in enumerate(("chosen1", "forced1", "chosen2", "forced2",
+                                  "free_mb", "conc_free", "health")):
+            if not np.array_equal(outs["xla"][i], outs["pallas"][i]):
+                print(f"# PARITY MISMATCH in {name}", file=sys.stderr)
+    return ok
+
+
+def _balancer_bench(n_invokers: int = 16, total: int = 2000,
+                    concurrency: int = 64) -> dict:
+    """TpuBalancer.publish() end-to-end on the in-memory bus with echo
+    invokers: the full host path (slot alloc, micro-batch assembly, device
+    step, promise fan-out, bus send) that the raw kernel number omits."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.core.entity import (ActionLimits, ActivationId,
+                                           ActivationResponse, CodeExec,
+                                           ControllerInstanceId, EntityName,
+                                           EntityPath, ExecutableWhiskAction,
+                                           Identity, InvokerInstanceId, MB,
+                                           MemoryLimit, TimeLimit,
+                                           WhiskActivation)
+    from openwhisk_tpu.core.entity.ids import DocRevision
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         CombinedCompletionAndResultMessage,
+                                         MemoryMessagingProvider, MessageFeed,
+                                         PingMessage)
+    from openwhisk_tpu.utils.transaction import TransactionId
+
+    def make_action(name, memory=256):
+        a = ExecutableWhiskAction(EntityPath("guest"), EntityName(name),
+                                  CodeExec(kind="python:3", code="x"),
+                                  limits=ActionLimits(TimeLimit(5000),
+                                                      MemoryLimit(MB(memory))))
+        a.rev = DocRevision("1-b")
+        return a
+
+    async def echo_invoker(provider, instance):
+        topic = instance.as_string
+        provider.ensure_topic(topic)
+        consumer = provider.get_consumer(topic, topic)
+        producer = provider.get_producer()
+        box = {}
+
+        async def handle(payload: bytes):
+            msg = ActivationMessage.parse(payload)
+            now = time.time()
+            act = WhiskActivation(
+                EntityPath(str(msg.user.namespace.name)), msg.action.name,
+                msg.user.subject, msg.activation_id, now, now,
+                ActivationResponse.success({"ok": True}), duration=1)
+            await producer.send(
+                f"completed{msg.root_controller_index.as_string}",
+                CombinedCompletionAndResultMessage(msg.transid, act, instance))
+            box["feed"].processed()
+
+        feed = MessageFeed(topic, consumer, 256, handle)
+        box["feed"] = feed
+        feed.start()
+        return feed
+
+    async def go() -> dict:
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0)
+        await bal.start()
+        feeds = []
+        producer = provider.get_producer()
+        for i in range(n_invokers):
+            inst = InvokerInstanceId(i, user_memory=MB(8192))
+            feeds.append(await echo_invoker(provider, inst))
+            await producer.send("health", PingMessage(inst))
+        await asyncio.sleep(0.3)
+
+        actions = [make_action(f"bench{i}", memory=128) for i in range(8)]
+        ident = Identity.generate("guest")
+        lat: list = []
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            action = actions[i % len(actions)]
+            msg = ActivationMessage(
+                TransactionId(), action.fully_qualified_name, action.rev.rev,
+                ident, ActivationId.generate(), ControllerInstanceId("0"),
+                True, {})
+            async with sem:
+                t0 = time.perf_counter()
+                promise = await bal.publish(action, msg)
+                lat.append(time.perf_counter() - t0)
+                await promise
+
+        # warmup: two rounds so the power-of-two schedule/release bucket
+        # shapes the measured run will hit are already compiled
+        for _ in range(2):
+            await asyncio.gather(*[one(i) for i in range(min(128, total))])
+        lat.clear()
+        t0 = time.perf_counter()
+        await asyncio.gather(*[one(i) for i in range(total)])
+        wall = time.perf_counter() - t0
+        await bal.close()
+        for f in feeds:
+            await f.stop()
+
+        lat.sort()
+        return {
+            "activations_per_sec": round(total / wall, 1),
+            "publish_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "publish_p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+            "concurrency": concurrency,
+            "n_invokers": n_invokers,
+        }
+
+    return asyncio.run(go())
 
 
 def _cpu_oracle_rate(n: int = N_INVOKERS, reqs: int = 2048) -> float:
@@ -102,6 +296,72 @@ def _cpu_oracle_rate(n: int = N_INVOKERS, reqs: int = 2048) -> float:
                     release(st, c, act, mem)
             placed.clear()
     return reqs / (time.perf_counter() - t0)
+
+
+def _sweep() -> None:
+    """xla-vs-pallas rate table across fleet/slot configs (stderr)."""
+    from openwhisk_tpu.ops.placement_pallas import fits_vmem
+    print("# N_invokers  action_slots  xla/s      pallas/s   winner",
+          file=sys.stderr)
+    for n in (128, 512, 1024, 4096):
+        for a in (64, 256):
+            if not fits_vmem(n, a):
+                print(f"# {n:<11} {a:<13} (pallas exceeds VMEM budget)",
+                      file=sys.stderr)
+                continue
+            x = _bench_kernel("xla", n, a, repeats=3, iters=20)
+            p = _bench_kernel("pallas", n, a, repeats=3, iters=20)
+            win = "pallas" if p["rate_median"] > x["rate_median"] else "xla"
+            print(f"# {n:<11} {a:<13} {x['rate_median']:<10.0f} "
+                  f"{p['rate_median']:<10.0f} {win}", file=sys.stderr)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kernel", choices=("xla", "pallas", "both"),
+                    default="both")
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the balancer-level benchmark")
+    ap.add_argument("--sweep", action="store_true",
+                    help="print an (N x A) xla-vs-pallas table to stderr")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.sweep:
+        _sweep()
+        return
+
+    kernels = {}
+    if args.kernel in ("xla", "both"):
+        kernels["xla"] = _bench_kernel("xla")
+    if args.kernel in ("pallas", "both"):
+        kernels["pallas"] = _bench_kernel("pallas")
+
+    parity_ok = _parity_check() if args.kernel == "both" else None
+
+    balancer = None if args.quick else _balancer_bench()
+
+    cpu_rate = _cpu_oracle_rate()
+    headline = kernels.get("xla") or kernels["pallas"]
+    print(f"# device={jax.devices()[0]} backend={jax.default_backend()} "
+          f"p50_step={headline['p50_step_ms']:.2f}ms "
+          f"cpu_oracle={cpu_rate:.0f}/s parity={parity_ok}", file=sys.stderr)
+
+    out = {
+        "metric": "placements_per_sec",
+        "value": headline["rate_median"],
+        "unit": "placements/s",
+        "vs_baseline": round(headline["rate_median"] / TARGET, 3),
+        "median_of": headline["repeats"],
+        "spread_pct": headline["spread_pct"],
+        "kernels": kernels,
+        "parity_ok": parity_ok,
+        "cpu_oracle_per_sec": round(cpu_rate, 1),
+    }
+    if balancer is not None:
+        out["balancer"] = balancer
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

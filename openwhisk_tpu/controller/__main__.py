@@ -16,13 +16,14 @@ import asyncio
 from ..core.entity import ControllerInstanceId, ExecManifest, WhiskAuthRecord
 from ..database import open_store
 from ..messaging.tcp import TcpMessagingProvider
-from ..utils.config import config_from_env
+from ..utils.config import config_from_env, honor_jax_platforms_env
 from ..utils.logging import Logging
 from .core import Controller
 from ..utils.tasks import wait_for_shutdown
 
 
 def main() -> None:
+    honor_jax_platforms_env()
     parser = argparse.ArgumentParser(description="OpenWhisk-TPU controller")
     parser.add_argument("--bus", default="127.0.0.1:4222")
     parser.add_argument("--db", required=True)

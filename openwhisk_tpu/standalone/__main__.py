@@ -5,10 +5,12 @@ import argparse
 import asyncio
 
 from . import GUEST_KEY, GUEST_UUID, make_standalone
+from ..utils.config import honor_jax_platforms_env
 from ..utils.tasks import wait_for_shutdown
 
 
 def main() -> None:
+    honor_jax_platforms_env()
     parser = argparse.ArgumentParser(description="Standalone OpenWhisk-TPU server")
     parser.add_argument("--port", type=int, default=3233)
     parser.add_argument("--db", type=str, default=None,

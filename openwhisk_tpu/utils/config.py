@@ -24,6 +24,23 @@ C = TypeVar("C")
 _CAMEL = re.compile(r"(?<!^)(?=[A-Z])")
 
 
+def honor_jax_platforms_env() -> None:
+    """Apply JAX_PLATFORMS through the config API.
+
+    Some PJRT plugins (e.g. the axon TPU tunnel) register themselves
+    regardless of the JAX_PLATFORMS env var, so exporting JAX_PLATFORMS=cpu
+    to a spawned service is silently ignored. Services that use JAX call
+    this at boot so the conventional env contract holds — test harnesses
+    and operators can pin a process to a backend the standard way.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", want)
+
+
 def _snake(name: str) -> str:
     return _CAMEL.sub("_", name).lower()
 
