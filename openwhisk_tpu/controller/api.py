@@ -10,6 +10,8 @@ route tree + auth directive) with the per-collection APIs:
   Rules.scala        CRUD + status
   Packages.scala     CRUD incl. bindings
 JSON wire shapes follow the reference so `wsk`-style clients port over.
+Every /api/v1 response carries the REST CORS headers (RestAPIs.scala:200,
+controller/cors.py); web actions manage their own CORS + OPTIONS preflight.
 """
 from __future__ import annotations
 
@@ -53,7 +55,8 @@ class ControllerApi:
 
     # ------------------------------------------------------------------ app
     def make_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._auth_middleware])
+        app = web.Application(middlewares=[self._cors_middleware,
+                                           self._auth_middleware])
         r = app.router
         r.add_get("/ping", self.ping)
         r.add_get("/api/v1", self.api_info)
@@ -88,6 +91,23 @@ class ControllerApi:
         return app
 
     # ----------------------------------------------------------- middleware
+    @web.middleware
+    async def _cors_middleware(self, request: web.Request, handler):
+        """Access-Control-* on every /api/v1 response (ref RestAPIs.scala:200
+        sendCorsHeaders). Web actions are excluded: they manage their own
+        wider CORS surface incl. OPTIONS preflight (RestAPIs.scala:214)."""
+        applies = (request.path.startswith("/api/v1")
+                   and not request.path.startswith("/api/v1/web/"))
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            if applies:
+                e.headers.update(self.c.cors.rest_headers())
+            raise
+        if applies:
+            resp.headers.update(self.c.cors.rest_headers())
+        return resp
+
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
         if request.path in ("/ping", "/api/v1", "/metrics",
@@ -454,6 +474,20 @@ class ControllerApi:
                                    Parameters.from_json(body.get("parameters")),
                                    annotations=Parameters.from_json(body.get("annotations")),
                                    publish=bool(body.get("publish", False)))
+            # feed annotation must name a feed action: 1-3 path segments
+            # (name | package/name | namespace/package/name), each a valid
+            # entity name (ref Triggers.scala validateTriggerFeed :282-303;
+            # the feed lifecycle invoke itself is the CLI's macro operation,
+            # tools/wsk.py)
+            feed = trigger.annotations.get("feed")
+            if feed is not None:
+                try:
+                    if not isinstance(feed, str) or \
+                            not 1 <= len(EntityPath(feed).segments) <= 3:
+                        raise ValueError(feed)
+                except ValueError:
+                    return _error(400, "Feed name is not valid",
+                                  request["transid"])
             try:
                 old = await self.c.entity_store.get_trigger(doc_id)
                 if not overwrite:
@@ -461,6 +495,15 @@ class ControllerApi:
                 trigger.version = old.version.up_patch()
                 trigger.rev = old.rev
                 trigger.rules = old.rules
+                # fields absent from the update body keep their stored
+                # values (ref Triggers.scala update: `content.annotations
+                # getOrElse trigger.annotations` etc., :265-278) — an update
+                # that only changes parameters must not erase, e.g., the
+                # feed annotation
+                if "annotations" not in body:
+                    trigger.annotations = old.annotations
+                if "parameters" not in body:
+                    trigger.parameters = old.parameters
             except NoDocumentException:
                 pass
             await self.c.entity_store.put(trigger)

@@ -19,6 +19,7 @@ from ..database import (ArtifactActivationStore, AuthStore, EntityStore,
                         RemoteCacheInvalidation)
 from ..utils.logging import Logging, MetricEmitter
 from .api import ControllerApi
+from .cors import CorsSettings
 from .loadbalancer.base import LoadBalancer
 from .authentication import BasicAuthenticationProvider
 from .entitlement import LocalEntitlementProvider
@@ -73,6 +74,7 @@ class Controller:
                                               self.conductor)
         # sequences route conductor components through the composition loop
         self.sequencer.conductor = self.conductor
+        self.cors = CorsSettings.from_env()
         self.web_actions = WebActionsApi(self)
         self.log_store = log_store if log_store is not None \
             else ContainerLogStore()

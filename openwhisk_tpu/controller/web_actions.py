@@ -8,6 +8,10 @@ under the action owner's identity, and the response is negotiated by the
 extension: .json (full result), .text/.html/.svg (one field rendered), .http
 (result dictates statusCode/headers/body). `raw-http` passes the body
 through unparsed; `final` locks exported parameters.
+
+CORS: responses carry the web-action CORS headers and OPTIONS preflight is
+answered by the platform (WebActions.scala:506-520, controller/cors.py)
+unless the `web-custom-options` annotation routes OPTIONS to the action.
 """
 from __future__ import annotations
 
@@ -81,18 +85,37 @@ class WebActionsApi:
                 return denied
         raw_http = action.annotations.get("raw-http") is True
 
+        # CORS + OPTIONS preflight (ref WebActions.scala:506-520): unless
+        # the action claims OPTIONS via `web-custom-options`, preflight is
+        # answered here and every response carries the web CORS headers.
+        # Deliberately AFTER the 404/require-whisk-auth checks above — the
+        # reference evaluates requiredWhiskAuthSuccessful first and its
+        # terminate(Unauthorized)/NotFound responses carry no CORS headers
+        # (WebActions.scala:503-511), so a require-whisk-auth action is
+        # likewise not preflightable here
+        custom_options = action.annotations.get("web-custom-options") is True
+        cors = None if custom_options else self.c.cors.web_headers(request.headers)
+        if cors is not None and request.method == "OPTIONS":
+            return web.Response(status=200, headers=cors)
+
         payload = await self._context_payload(request, raw_http)
         transid = TransactionId()
         outcome = await self.c.invoker.invoke(owner, action, pkg_params, payload,
                                               blocking=True, transid=transid)
         if outcome.accepted or outcome.activation is None:
-            return web.json_response({"error": "Response not yet ready."}, status=502)
-        result = outcome.activation.response.result or {}
-        if not outcome.activation.response.is_success and ext != ".http":
-            return web.json_response({"error": result.get("error", "request failed"),
-                                      "activationId": outcome.activation_id.asString},
-                                     status=502)
-        return self._render(result, ext)
+            resp = web.json_response({"error": "Response not yet ready."}, status=502)
+        else:
+            result = outcome.activation.response.result or {}
+            if not outcome.activation.response.is_success and ext != ".http":
+                resp = web.json_response(
+                    {"error": result.get("error", "request failed"),
+                     "activationId": outcome.activation_id.asString},
+                    status=502)
+            else:
+                resp = self._render(result, ext)
+        if cors is not None:
+            resp.headers.update(cors)
+        return resp
 
     async def _context_payload(self, request: web.Request, raw_http: bool) -> dict:
         body = await request.read()

@@ -56,6 +56,31 @@ def _kv_list(d):
     return [{"key": k, "value": v} for k, v in d.items()]
 
 
+def _feed_action_path(feed: str, ns: str):
+    """Resolve a feed name to (namespace, action path): a leading slash
+    means fully qualified (`/ns/name` or `/ns/pkg/name`); without it,
+    `name` and `pkg/name` are relative to the caller's namespace and three
+    segments are fully qualified (packages don't nest)."""
+    qualified = feed.startswith("/")
+    segs = [s for s in feed.strip("/").split("/") if s]
+    if qualified or len(segs) == 3:
+        return segs[0], "/".join(segs[1:])
+    return ns, "/".join(segs)
+
+
+async def _invoke_feed(client, feed: str, lifecycle_event: str,
+                       trigger_name: str, auth: str, params: dict):
+    """Run the feed action with the standard feed-protocol arguments
+    (lifecycleEvent, triggerName, authKey — ref docs/feeds.md:59-66)."""
+    feed_ns, feed_path = _feed_action_path(feed, "_")
+    body = dict(params)
+    body.update({"lifecycleEvent": lifecycle_event,
+                 "triggerName": trigger_name, "authKey": auth})
+    return await client.request(
+        "POST", f"/namespaces/{feed_ns}/actions/{feed_path}", body,
+        {"blocking": "true"})
+
+
 async def run(args) -> int:
     apihost = args.apihost or os.environ.get("WSK_APIHOST", "http://127.0.0.1:3233")
     auth = args.auth or os.environ.get("WSK_AUTH", "")
@@ -121,19 +146,68 @@ async def run(args) -> int:
                 "GET", f"/namespaces/{ns}/activations/{args.name}{suffix}"))
     elif e == "trigger":
         if args.cmd in ("create", "update"):
-            body = {"parameters": _kv_list(_params_to_dict(args.param))}
+            if args.feed and args.cmd == "update":
+                # changing a feed means tearing one down and creating
+                # another — not an in-place update (matches the wsk CLI)
+                print("error: --feed is not supported on trigger update; "
+                      "delete and re-create the trigger", file=sys.stderr)
+                return 2
+            # omit fields the user didn't pass: the controller keeps the
+            # stored values on overwrite, so a bare `trigger update -p ...`
+            # cannot erase the feed annotation
+            body = {}
+            if args.param:
+                body["parameters"] = _kv_list(_params_to_dict(args.param))
+            if args.annotation or args.feed:
+                body["annotations"] = _kv_list(_params_to_dict(args.annotation))
+            if args.feed:
+                body["annotations"].append({"key": "feed", "value": args.feed})
             params = {"overwrite": "true"} if args.cmd == "update" else {}
-            return show(*await client.request(
-                "PUT", f"/namespaces/{ns}/triggers/{args.name}", body, params))
+            status, data = await client.request(
+                "PUT", f"/namespaces/{ns}/triggers/{args.name}", body, params)
+            if status < 400 and args.feed and args.cmd == "create":
+                # the create+feed macro (ref docs/feeds.md, CLI behavior):
+                # invoke the feed action with the CREATE lifecycle event; on
+                # failure roll the trigger back so the two stay atomic
+                fs, fd = await _invoke_feed(client, args.feed, "CREATE",
+                                            f"/{ns}/{args.name}", auth,
+                                            _params_to_dict(args.param))
+                if fs >= 400:
+                    await client.request(
+                        "DELETE", f"/namespaces/{ns}/triggers/{args.name}")
+                    print(f"error: feed action failed ({fs}); "
+                          "trigger rolled back", file=sys.stderr)
+                    return show(fs, fd)
+            return show(status, data)
         if args.cmd == "fire":
             return show(*await client.request(
                 "POST", f"/namespaces/{ns}/triggers/{args.name}",
                 _params_to_dict(args.param)))
-        if args.cmd in ("get", "delete", "list"):
-            method = {"get": "GET", "delete": "DELETE", "list": "GET"}[args.cmd]
+        if args.cmd == "delete":
+            # feed-annotated triggers tear their feed down first (DELETE
+            # lifecycle event), then the trigger document goes
+            gs, gd = await client.request(
+                "GET", f"/namespaces/{ns}/triggers/{args.name}")
+            feed = None
+            if gs < 400:
+                feed = next((a.get("value") for a in gd.get("annotations", [])
+                             if a.get("key") == "feed"), None)
+            feed_failed = False
+            if feed:
+                fs, _fd = await _invoke_feed(client, feed, "DELETE",
+                                             f"/{ns}/{args.name}", auth, {})
+                if fs >= 400:
+                    feed_failed = True
+                    print(f"warning: feed teardown failed ({fs}); the "
+                          f"provider-side feed '{feed}' may still be live",
+                          file=sys.stderr)
+            rc = show(*await client.request(
+                "DELETE", f"/namespaces/{ns}/triggers/{args.name}"))
+            return 1 if feed_failed else rc
+        if args.cmd in ("get", "list"):
             path = f"/namespaces/{ns}/triggers" + \
                 ("" if args.cmd == "list" else f"/{args.name}")
-            return show(*await client.request(method, path))
+            return show(*await client.request("GET", path))
     elif e == "rule":
         if args.cmd == "create":
             return show(*await client.request(
@@ -226,6 +300,10 @@ def main(argv=None) -> int:
     parser.add_argument("--blocking", "-b", action="store_true")
     parser.add_argument("--result", "-r", action="store_true")
     parser.add_argument("--limit", "-l", type=int, default=30)
+    parser.add_argument("--feed", default=None,
+                        help="trigger create: feed action (name, pkg/name, "
+                             "or /ns/pkg/name); invoked with the CREATE/"
+                             "DELETE lifecycle events")
     parser.add_argument("--trigger", default=None, help="rule create: trigger name")
     parser.add_argument("--action", default=None,
                         help="rule/api create: target action name")

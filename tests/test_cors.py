@@ -1,0 +1,121 @@
+"""CORS on the REST API and web actions (ref CorsSettings.scala,
+RestAPIs.scala:200,214, WebActions.scala:506-520): every /api/v1 response
+carries Access-Control-* headers; web actions answer OPTIONS preflight
+directly (echoing requested headers) unless `web-custom-options` hands
+OPTIONS to the action itself."""
+import asyncio
+import base64
+
+import aiohttp
+
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID, make_standalone
+
+AUTH = "Basic " + base64.b64encode(f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+
+PORT = 13263
+BASE = f"http://127.0.0.1:{PORT}/api/v1"
+
+ECHO_CODE = """
+def main(args):
+    return {'method': args.get('__ow_method', '?')}
+"""
+
+
+async def _serve(coro_fn):
+    controller = await make_standalone(port=PORT)
+    try:
+        async with aiohttp.ClientSession() as session:
+            return await coro_fn(session)
+    finally:
+        await controller.stop()
+
+
+def run_system(coro_fn):
+    return asyncio.run(_serve(coro_fn))
+
+
+class TestRestCors:
+    def test_api_v1_responses_carry_cors_headers(self):
+        async def go(s):
+            out = {}
+            async with s.get(f"{BASE}/namespaces", headers=HDRS) as r:
+                out["ok"] = (r.status, dict(r.headers))
+            # errors carry them too (browser must be able to read a 401)
+            async with s.get(f"{BASE}/namespaces") as r:
+                out["unauth"] = (r.status, dict(r.headers))
+            async with s.get(f"{BASE}/namespaces/_/actions/ghost",
+                             headers=HDRS) as r:
+                out["missing"] = (r.status, dict(r.headers))
+            return out
+
+        out = run_system(go)
+        assert out["ok"][0] == 200
+        for name in ("ok", "unauth", "missing"):
+            headers = out[name][1]
+            assert headers.get("Access-Control-Allow-Origin") == "*", name
+            assert "Authorization" in headers.get(
+                "Access-Control-Allow-Headers", ""), name
+            methods = headers.get("Access-Control-Allow-Methods", "")
+            assert "GET" in methods and "PUT" in methods, name
+            # REST surface: no OPTIONS in the method list (ref RestAPIs)
+            assert "OPTIONS" not in methods, name
+
+
+class TestWebActionCors:
+    def _create(self, s, name, annotations):
+        return s.put(
+            f"{BASE}/namespaces/_/actions/{name}", headers=HDRS,
+            json={"exec": {"kind": "python:3", "code": ECHO_CODE},
+                  "annotations": annotations})
+
+    def test_preflight_answered_directly(self):
+        async def go(s):
+            async with self._create(s, "webcors", [
+                    {"key": "web-export", "value": True}]) as r:
+                assert r.status == 200
+            out = {}
+            async with s.options(
+                    f"{BASE}/web/guest/default/webcors.json",
+                    headers={"Origin": "https://app.example",
+                             "Access-Control-Request-Method": "POST",
+                             "Access-Control-Request-Headers":
+                                 "content-type, x-custom"}) as r:
+                out["preflight"] = (r.status, dict(r.headers),
+                                    await r.text())
+            async with s.post(f"{BASE}/web/guest/default/webcors.json",
+                              json={}) as r:
+                out["actual"] = (r.status, dict(r.headers), await r.json())
+            return out
+
+        out = run_system(go)
+        status, headers, body = out["preflight"]
+        assert status == 200 and body in ("", None)
+        assert headers["Access-Control-Allow-Origin"] == "*"
+        # requested headers echoed back verbatim (WebActions.scala:415-418)
+        assert headers["Access-Control-Allow-Headers"] == \
+            "content-type, x-custom"
+        assert "OPTIONS" in headers["Access-Control-Allow-Methods"]
+        assert "PATCH" in headers["Access-Control-Allow-Methods"]
+
+        status, headers, body = out["actual"]
+        assert status == 200 and body == {"method": "post"}
+        assert headers["Access-Control-Allow-Origin"] == "*"
+        # no request-header echo on an actual request: default list
+        assert "Authorization" in headers["Access-Control-Allow-Headers"]
+
+    def test_web_custom_options_hands_options_to_action(self):
+        async def go(s):
+            async with self._create(s, "customopt", [
+                    {"key": "web-export", "value": True},
+                    {"key": "web-custom-options", "value": True}]) as r:
+                assert r.status == 200
+            async with s.options(
+                    f"{BASE}/web/guest/default/customopt.json") as r:
+                return r.status, dict(r.headers), await r.json()
+
+        status, headers, body = run_system(go)
+        # the ACTION saw the OPTIONS request and built the response
+        assert status == 200 and body == {"method": "options"}
+        # and the platform added no CORS headers (action's job now)
+        assert "Access-Control-Allow-Origin" not in headers

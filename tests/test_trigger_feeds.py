@@ -1,0 +1,187 @@
+"""Trigger feeds: feed-annotation validation on trigger PUT (ref
+Triggers.scala validateTriggerFeed :282-303) and the CLI's create/delete
+macro that drives the feed action with lifecycleEvent CREATE/DELETE +
+triggerName + authKey (ref docs/feeds.md:55-80)."""
+import asyncio
+import base64
+
+import aiohttp
+
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID, make_standalone
+from openwhisk_tpu.tools import wsk
+
+AUTH_PAIR = f"{GUEST_UUID}:{GUEST_KEY}"
+AUTH = "Basic " + base64.b64encode(AUTH_PAIR.encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+
+PORT = 13273
+HOST = f"http://127.0.0.1:{PORT}"
+BASE = f"{HOST}/api/v1"
+
+FEED_CODE = """
+def main(args):
+    return {'seen': args}
+"""
+
+BAD_FEED_CODE = """
+def main(args):
+    return {'error': 'feed provisioning exploded'}
+"""
+
+
+async def _serve(coro_fn):
+    controller = await make_standalone(port=PORT)
+    try:
+        async with aiohttp.ClientSession() as session:
+            return await coro_fn(session)
+    finally:
+        await controller.stop()
+
+
+def run_system(coro_fn):
+    return asyncio.run(_serve(coro_fn))
+
+
+async def _wsk(*argv) -> int:
+    """Run the CLI in a worker thread (it owns its own event loop)."""
+    return await asyncio.to_thread(
+        wsk.main, ["--apihost", HOST, "--auth", AUTH_PAIR, *argv])
+
+
+async def _feed_activation_results(s, name):
+    async with s.get(f"{BASE}/namespaces/_/activations",
+                     headers=HDRS, params={"name": name}) as r:
+        summaries = await r.json()
+    results = []
+    for summary in summaries:
+        aid = summary["activationId"]
+        async with s.get(f"{BASE}/namespaces/_/activations/{aid}/result",
+                         headers=HDRS) as r:
+            results.append((await r.json()).get("result"))
+    return results
+
+
+class TestFeedAnnotationValidation:
+    def test_invalid_feed_annotation_rejected(self):
+        async def go(s):
+            out = {}
+            for bad in (123, "", "a/b/c/d", "bad name!"):
+                async with s.put(
+                        f"{BASE}/namespaces/_/triggers/tbad", headers=HDRS,
+                        json={"annotations": [
+                            {"key": "feed", "value": bad}]}) as r:
+                    out[str(bad)] = (r.status, (await r.json()).get("error"))
+            return out
+
+        out = run_system(go)
+        for bad, (status, error) in out.items():
+            assert status == 400, bad
+            assert error == "Feed name is not valid", bad
+
+    def test_valid_feed_annotation_accepted(self):
+        async def go(s):
+            async with s.put(
+                    f"{BASE}/namespaces/_/triggers/tok", headers=HDRS,
+                    json={"annotations": [
+                        {"key": "feed", "value": "alarms/interval"}]}) as r:
+                return r.status, await r.json()
+
+        status, doc = run_system(go)
+        assert status == 200
+        assert {"key": "feed", "value": "alarms/interval"} in doc["annotations"]
+
+
+class TestFeedLifecycle:
+    def test_create_invokes_feed_and_delete_tears_down(self):
+        async def go(s):
+            async with s.put(f"{BASE}/namespaces/_/actions/feedact",
+                             headers=HDRS,
+                             json={"exec": {"kind": "python:3",
+                                            "code": FEED_CODE}}) as r:
+                assert r.status == 200
+            rc_create = await _wsk("trigger", "create", "t1",
+                                   "--feed", "feedact",
+                                   "-p", "dbname", "mydb")
+            async with s.get(f"{BASE}/namespaces/_/triggers/t1",
+                             headers=HDRS) as r:
+                trig = (r.status, await r.json())
+            after_create = await _feed_activation_results(s, "feedact")
+            rc_delete = await _wsk("trigger", "delete", "t1")
+            after_delete = await _feed_activation_results(s, "feedact")
+            async with s.get(f"{BASE}/namespaces/_/triggers/t1",
+                             headers=HDRS) as r:
+                gone = r.status
+            return rc_create, trig, after_create, rc_delete, after_delete, gone
+
+        rc_create, trig, after_create, rc_delete, after_delete, gone = \
+            run_system(go)
+        assert rc_create == 0
+        assert trig[0] == 200
+        assert {"key": "feed", "value": "feedact"} in trig[1]["annotations"]
+
+        assert len(after_create) == 1
+        seen = after_create[0]["seen"]
+        assert seen["lifecycleEvent"] == "CREATE"
+        assert seen["triggerName"] == "/_/t1"
+        assert seen["authKey"] == AUTH_PAIR
+        assert seen["dbname"] == "mydb"
+
+        assert rc_delete == 0 and gone == 404
+        events = sorted(r["seen"]["lifecycleEvent"] for r in after_delete)
+        assert events == ["CREATE", "DELETE"]
+
+    def test_update_preserves_feed_annotation(self):
+        """`trigger update -p ...` must not erase the stored feed
+        annotation (ref Triggers.scala update: absent fields keep stored
+        values), and --feed on update is rejected outright."""
+        async def go(s):
+            async with s.put(f"{BASE}/namespaces/_/actions/feedact2",
+                             headers=HDRS,
+                             json={"exec": {"kind": "python:3",
+                                            "code": FEED_CODE}}) as r:
+                assert r.status == 200
+            assert await _wsk("trigger", "create", "t3",
+                              "--feed", "feedact2") == 0
+            rc_update = await _wsk("trigger", "update", "t3",
+                                   "-p", "cron", "* * * * *")
+            async with s.get(f"{BASE}/namespaces/_/triggers/t3",
+                             headers=HDRS) as r:
+                doc = await r.json()
+            rc_feed_update = await _wsk("trigger", "update", "t3",
+                                        "--feed", "other")
+            return rc_update, doc, rc_feed_update
+
+        rc_update, doc, rc_feed_update = run_system(go)
+        assert rc_update == 0
+        assert {"key": "feed", "value": "feedact2"} in doc["annotations"], \
+            "update must not erase the feed annotation"
+        assert any(p == {"key": "cron", "value": "* * * * *"}
+                   for p in doc["parameters"])
+        assert rc_feed_update == 2, "--feed on update must be rejected"
+
+    def test_feed_action_path_resolution(self):
+        assert wsk._feed_action_path("changes", "_") == ("_", "changes")
+        assert wsk._feed_action_path("cloudant/changes", "_") == \
+            ("_", "cloudant/changes")
+        assert wsk._feed_action_path("/whisk.system/alarms/alarm", "_") == \
+            ("whisk.system", "alarms/alarm")
+        # fully qualified WITHOUT a package: the leading slash decides
+        assert wsk._feed_action_path("/provider/feedaction", "_") == \
+            ("provider", "feedaction")
+        assert wsk._feed_action_path("ns/pkg/name", "_") == ("ns", "pkg/name")
+
+    def test_failed_feed_rolls_back_trigger(self):
+        async def go(s):
+            async with s.put(f"{BASE}/namespaces/_/actions/badfeed",
+                             headers=HDRS,
+                             json={"exec": {"kind": "python:3",
+                                            "code": BAD_FEED_CODE}}) as r:
+                assert r.status == 200
+            rc = await _wsk("trigger", "create", "t2", "--feed", "badfeed")
+            async with s.get(f"{BASE}/namespaces/_/triggers/t2",
+                             headers=HDRS) as r:
+                return rc, r.status
+
+        rc, status = run_system(go)
+        assert rc != 0, "CLI must report the feed failure"
+        assert status == 404, "trigger must be rolled back"
