@@ -38,6 +38,8 @@ def main() -> None:
 
     async def run():
         logger = Logging(level="info")
+        from ..utils.tracing import maybe_enable_zipkin
+        zipkin = maybe_enable_zipkin(f"controller{args.instance}")
         ExecManifest.initialize()
         host, _, port = args.bus.partition(":")
         provider = TcpMessagingProvider(host, int(port or 4222))
@@ -74,6 +76,8 @@ def main() -> None:
             await wait_for_shutdown()
         finally:
             await controller.stop()
+            if zipkin is not None:
+                await zipkin.close()
 
     asyncio.run(run())
 

@@ -483,7 +483,11 @@ class ControllerApi:
             if feed is not None:
                 try:
                     if not isinstance(feed, str) or \
-                            not 1 <= len(EntityPath(feed).segments) <= 3:
+                            not 1 <= len(EntityPath(feed).segments) <= 3 or \
+                            (feed.startswith("/")
+                             and len(EntityPath(feed).segments) < 2):
+                        # a leading slash claims full qualification, which
+                        # needs at least namespace + action
                         raise ValueError(feed)
                 except ValueError:
                     return _error(400, "Feed name is not valid",

@@ -39,6 +39,8 @@ def main() -> None:
 
     async def run():
         logger = Logging(level="info")
+        from ..utils.tracing import maybe_enable_zipkin
+        zipkin = maybe_enable_zipkin(f"invoker-{args.unique_name}")
         ExecManifest.initialize()
         host, _, port = args.bus.partition(":")
         provider = TcpMessagingProvider(host, int(port or 4222))
@@ -66,6 +68,8 @@ def main() -> None:
             if server:
                 await server.stop()
             await invoker.stop()
+            if zipkin is not None:
+                await zipkin.close()
 
     asyncio.run(run())
 

@@ -63,6 +63,10 @@ def _feed_action_path(feed: str, ns: str):
     segments are fully qualified (packages don't nest)."""
     qualified = feed.startswith("/")
     segs = [s for s in feed.strip("/").split("/") if s]
+    if qualified and len(segs) < 2:
+        raise ValueError(
+            f"feed {feed!r}: a fully-qualified feed needs a namespace AND "
+            "an action (/ns/name or /ns/pkg/name)")
     if qualified or len(segs) == 3:
         return segs[0], "/".join(segs[1:])
     return ns, "/".join(segs)
@@ -72,7 +76,10 @@ async def _invoke_feed(client, feed: str, lifecycle_event: str,
                        trigger_name: str, auth: str, params: dict):
     """Run the feed action with the standard feed-protocol arguments
     (lifecycleEvent, triggerName, authKey — ref docs/feeds.md:59-66)."""
-    feed_ns, feed_path = _feed_action_path(feed, "_")
+    try:
+        feed_ns, feed_path = _feed_action_path(feed, "_")
+    except ValueError as e:
+        return 400, {"error": str(e)}
     body = dict(params)
     body.update({"lifecycleEvent": lifecycle_event,
                  "triggerName": trigger_name, "authKey": auth})
@@ -168,16 +175,18 @@ async def run(args) -> int:
             if status < 400 and args.feed and args.cmd == "create":
                 # the create+feed macro (ref docs/feeds.md, CLI behavior):
                 # invoke the feed action with the CREATE lifecycle event; on
-                # failure roll the trigger back so the two stay atomic
+                # anything but a confirmed success (200) — failure, or a 202
+                # blocking-invoke timeout whose outcome is unknown — roll
+                # the trigger back so the two stay atomic
                 fs, fd = await _invoke_feed(client, args.feed, "CREATE",
                                             f"/{ns}/{args.name}", auth,
                                             _params_to_dict(args.param))
-                if fs >= 400:
+                if fs != 200:
                     await client.request(
                         "DELETE", f"/namespaces/{ns}/triggers/{args.name}")
-                    print(f"error: feed action failed ({fs}); "
+                    print(f"error: feed action did not succeed ({fs}); "
                           "trigger rolled back", file=sys.stderr)
-                    return show(fs, fd)
+                    return show(fs, fd) or 1
             return show(status, data)
         if args.cmd == "fire":
             return show(*await client.request(

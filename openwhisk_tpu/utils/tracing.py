@@ -4,12 +4,17 @@ Rebuild of common/scala/.../common/tracing/OpenTracingProvider.scala:43-160 —
 a per-transid stack of spans; the active span's context serializes into
 `ActivationMessage.trace_context` (W3C traceparent style) and is restored on
 the invoker side, so traces survive the bus hop (Message.scala:61,
-InvokerReactive.scala:224). Finished spans go to a pluggable reporter
-(default: in-memory buffer; an OTLP/Zipkin exporter plugs in behind
-`Reporter`). Span caches expire so abandoned transactions don't leak.
+InvokerReactive.scala:224). Finished spans go to a pluggable reporter:
+in-memory buffer by default, `ZipkinReporter` (Zipkin v2 JSON over HTTP,
+the reference's reporting backend, OpenTracingProvider.scala:43-160 +
+application.conf:461-476) when CONFIG_whisk_tracing_zipkinUrl is set —
+see `maybe_enable_zipkin`. Span caches expire so abandoned transactions
+don't leak.
 """
 from __future__ import annotations
 
+import asyncio
+import json
 import secrets
 import time
 from dataclasses import dataclass, field
@@ -50,6 +55,125 @@ class BufferReporter(Reporter):
     def report(self, span: Span) -> None:
         if len(self.spans) < self.max_spans:
             self.spans.append(span)
+
+
+class ZipkinReporter(Reporter):
+    """Zipkin v2 JSON-over-HTTP reporter (POST {url}/api/v2/spans).
+
+    Spans buffer host-side and flush asynchronously — at `batch_size`, on
+    the `flush_interval` tick, or at close(). A dead collector costs one
+    failed POST per flush window and drops those spans; tracing must never
+    take the data plane down with it.
+    """
+
+    def __init__(self, url: str, service_name: str = "openwhisk-tpu",
+                 batch_size: int = 100, flush_interval: float = 1.0,
+                 logger=None):
+        self.url = url.rstrip("/") + "/api/v2/spans"
+        self.service_name = service_name
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.logger = logger
+        self._pending: List[Span] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self._session = None  # lazily-created, kept for connection reuse
+        self.sent_spans = 0
+        self.dropped_spans = 0
+
+    def report(self, span: Span) -> None:
+        self._pending.append(span)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync tooling): spans flush on explicit close()
+        full = len(self._pending) >= self.batch_size
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = loop.create_task(
+                self._flush_later(0.0 if full else self.flush_interval))
+        elif full:
+            # a flush is scheduled but still sleeping out its interval —
+            # the batch is full NOW, so replace it with an immediate one
+            self._flush_task.cancel()
+            self._flush_task = loop.create_task(self._flush_later(0.0))
+
+    async def _flush_later(self, delay: float) -> None:
+        if delay:
+            await asyncio.sleep(delay)
+        await self.flush()
+
+    def _encode(self, spans: List[Span]) -> bytes:
+        out = []
+        for s in spans:
+            doc = s.to_json()
+            doc["localEndpoint"] = {"serviceName": self.service_name}
+            doc["tags"] = {k: str(v) for k, v in doc["tags"].items()}
+            if doc["parentId"] is None:
+                del doc["parentId"]
+            out.append(doc)
+        return json.dumps(out).encode()
+
+    async def flush(self) -> None:
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        try:
+            import aiohttp
+
+            if self._session is None or self._session.closed:
+                self._session = aiohttp.ClientSession()
+            async with self._session.post(
+                    self.url, data=self._encode(batch),
+                    headers={"Content-Type": "application/json"},
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                if resp.status >= 400:
+                    raise RuntimeError(f"collector returned {resp.status}")
+            self.sent_spans += len(batch)
+        except asyncio.CancelledError:
+            # cancelled mid-POST (full-batch preemption or close()): the
+            # popped batch goes back so the next flush re-sends it instead
+            # of losing it uncounted
+            self._pending = batch + self._pending
+            raise
+        except Exception as e:  # noqa: BLE001 — tracing is best-effort
+            self.dropped_spans += len(batch)
+            if self.logger:
+                self.logger.warn(None, f"zipkin flush failed, dropped "
+                                       f"{len(batch)} spans: {e}")
+
+    async def close(self) -> None:
+        if self._flush_task and not self._flush_task.done():
+            self._flush_task.cancel()
+            try:
+                await self._flush_task
+            except asyncio.CancelledError:
+                pass
+        await self.flush()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+@dataclass
+class TracingSettings:
+    zipkin_url: Optional[str] = None
+    batch_size: int = 100
+    flush_interval: float = 1.0
+
+
+def maybe_enable_zipkin(service_name: str,
+                        tracer: Optional["Tracer"] = None) -> Optional[ZipkinReporter]:
+    """Swap the Zipkin reporter in when CONFIG_whisk_tracing_zipkinUrl is
+    exported (the reference gates identically on a configured zipkin url,
+    application.conf:461-476). Returns the reporter, or None when unset."""
+    from .config import load_config
+
+    cfg = load_config(TracingSettings, env_path="tracing")
+    if not cfg.zipkin_url:
+        return None
+    reporter = ZipkinReporter(cfg.zipkin_url, service_name=service_name,
+                              batch_size=cfg.batch_size,
+                              flush_interval=cfg.flush_interval)
+    (tracer or GLOBAL_TRACER).reporter = reporter
+    return reporter
 
 
 class Tracer:

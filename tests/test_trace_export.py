@@ -1,0 +1,180 @@
+"""Zipkin trace export (ref OpenTracingProvider.scala:43-160 + the zipkin
+config block application.conf:461-476): finished spans batch and POST to
+{url}/api/v2/spans as Zipkin v2 JSON; a dead collector drops spans without
+disturbing the caller; CONFIG_whisk_tracing_zipkinUrl swaps the reporter in.
+"""
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from openwhisk_tpu.utils.tracing import (Tracer, ZipkinReporter,
+                                         maybe_enable_zipkin)
+from openwhisk_tpu.utils.transaction import TransactionId
+
+
+class FakeCollector:
+    def __init__(self, delay: float = 0.0):
+        self.batches = []
+        self.status = 202
+        self.delay = delay
+        self.runner = None
+        self.port = None
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_post("/api/v2/spans", self.handle)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{self.port}"
+
+    async def handle(self, request):
+        assert request.content_type == "application/json"
+        body = await request.json()
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        self.batches.append(body)
+        return web.Response(status=self.status)
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+    @property
+    def spans(self):
+        return [s for b in self.batches for s in b]
+
+
+class TestZipkinReporter:
+    def test_spans_exported_in_zipkin_v2_shape(self):
+        async def go():
+            collector = FakeCollector()
+            url = await collector.start()
+            tracer = Tracer(ZipkinReporter(url, service_name="controller0",
+                                           flush_interval=0.05))
+            transid = TransactionId()
+            parent = tracer.start_span("controller_activation", transid)
+            child = tracer.start_span("loadbalancer_publish", transid)
+            tracer.finish_span(transid, {"invoker": "invoker0"}, span=child)
+            tracer.finish_span(transid, {"action": "guest/hello"}, span=parent)
+            await asyncio.sleep(0.2)  # flush tick
+            await tracer.reporter.close()
+            await collector.stop()
+            return collector.spans, parent, child
+
+        spans, parent, child = asyncio.run(go())
+        assert len(spans) == 2
+        by_name = {s["name"]: s for s in spans}
+        pub = by_name["loadbalancer_publish"]
+        act = by_name["controller_activation"]
+        # same trace, correct parentage
+        assert pub["traceId"] == act["traceId"] == parent.trace_id
+        assert pub["parentId"] == act["id"] == parent.span_id
+        assert "parentId" not in act  # root span omits the field
+        assert act["localEndpoint"] == {"serviceName": "controller0"}
+        # zipkin v2 units: microseconds, string tags
+        assert act["duration"] >= 0 and isinstance(act["timestamp"], int)
+        assert pub["tags"] == {"invoker": "invoker0"}
+
+    def test_batching_by_size_and_close_flush(self):
+        async def go():
+            collector = FakeCollector()
+            url = await collector.start()
+            reporter = ZipkinReporter(url, batch_size=3, flush_interval=30.0)
+            tracer = Tracer(reporter)
+            for i in range(3):
+                t = TransactionId()
+                tracer.start_span(f"s{i}", t)
+                tracer.finish_span(t)
+            await asyncio.sleep(0.1)  # size-triggered flush (3 spans)
+            t = TransactionId()
+            tracer.start_span("s3", t)
+            tracer.finish_span(t)
+            mid = [len(b) for b in collector.batches]
+            await reporter.close()  # drains the 4th without waiting 30 s
+            await collector.stop()
+            return mid, [len(b) for b in collector.batches], reporter
+
+        mid, final, reporter = asyncio.run(go())
+        assert mid == [3]
+        assert final == [3, 1]
+        assert reporter.sent_spans == 4 and reporter.dropped_spans == 0
+
+    def test_close_mid_flush_accounts_for_every_span(self):
+        """close() while a flush is mid-POST must not vanish the popped
+        batch: cancelled batches re-queue and are re-sent (or counted
+        dropped) by close's final flush."""
+        async def go():
+            collector = FakeCollector(delay=0.25)
+            url = await collector.start()
+            reporter = ZipkinReporter(url, flush_interval=0.01)
+            tracer = Tracer(reporter)
+            for i in range(2):
+                t = TransactionId()
+                tracer.start_span(f"s{i}", t)
+                tracer.finish_span(t)
+            await asyncio.sleep(0.1)  # flush is now awaiting the slow POST
+            await reporter.close()
+            await collector.stop()
+            return reporter
+
+        reporter = asyncio.run(go())
+        assert reporter.sent_spans + reporter.dropped_spans == 2, \
+            "cancelled mid-POST batch must be re-queued, not lost uncounted"
+
+    def test_dead_collector_drops_without_raising(self):
+        async def go():
+            reporter = ZipkinReporter("http://127.0.0.1:1",  # nothing listens
+                                      flush_interval=0.01)
+            tracer = Tracer(reporter)
+            t = TransactionId()
+            tracer.start_span("doomed", t)
+            tracer.finish_span(t)
+            await asyncio.sleep(0.1)
+            await reporter.close()
+            return reporter
+
+        reporter = asyncio.run(go())
+        assert reporter.dropped_spans == 1 and reporter.sent_spans == 0
+
+    def test_collector_error_status_counts_dropped(self):
+        async def go():
+            collector = FakeCollector()
+            collector.status = 500
+            url = await collector.start()
+            reporter = ZipkinReporter(url, flush_interval=0.01)
+            tracer = Tracer(reporter)
+            t = TransactionId()
+            tracer.start_span("rejected", t)
+            tracer.finish_span(t)
+            await asyncio.sleep(0.15)
+            await reporter.close()
+            await collector.stop()
+            return reporter
+
+        reporter = asyncio.run(go())
+        assert reporter.dropped_spans == 1
+
+
+class TestConfigGate:
+    def test_disabled_without_env(self, monkeypatch):
+        monkeypatch.delenv("CONFIG_whisk_tracing_zipkinUrl", raising=False)
+        tracer = Tracer()
+        before = tracer.reporter
+        assert maybe_enable_zipkin("controller0", tracer) is None
+        assert tracer.reporter is before
+
+    def test_enabled_with_env(self, monkeypatch):
+        monkeypatch.setenv("CONFIG_whisk_tracing_zipkinUrl",
+                           "http://zipkin:9411")
+        monkeypatch.setenv("CONFIG_whisk_tracing_batchSize", "7")
+        tracer = Tracer()
+        reporter = maybe_enable_zipkin("invoker-a", tracer)
+        assert isinstance(reporter, ZipkinReporter)
+        assert tracer.reporter is reporter
+        assert reporter.url == "http://zipkin:9411/api/v2/spans"
+        assert reporter.batch_size == 7
+        assert reporter.service_name == "invoker-a"

@@ -65,7 +65,7 @@ class TestFeedAnnotationValidation:
     def test_invalid_feed_annotation_rejected(self):
         async def go(s):
             out = {}
-            for bad in (123, "", "a/b/c/d", "bad name!"):
+            for bad in (123, "", "a/b/c/d", "bad name!", "/onlyns"):
                 async with s.put(
                         f"{BASE}/namespaces/_/triggers/tbad", headers=HDRS,
                         json={"annotations": [
@@ -160,6 +160,9 @@ class TestFeedLifecycle:
         assert rc_feed_update == 2, "--feed on update must be rejected"
 
     def test_feed_action_path_resolution(self):
+        import pytest
+        with pytest.raises(ValueError, match="fully-qualified"):
+            wsk._feed_action_path("/onlyns", "_")
         assert wsk._feed_action_path("changes", "_") == ("_", "changes")
         assert wsk._feed_action_path("cloudant/changes", "_") == \
             ("_", "cloudant/changes")
