@@ -241,8 +241,12 @@ def main() -> None:
         except (ValueError, OSError, ImportError):
             pass
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    print(f"action proxy listening on {port}", flush=True)
+    # optional bind host: a container runtime hands each sandbox its own
+    # address (e.g. per-container loopback IPs); default matches the
+    # process factory's 127.0.0.1
+    host = sys.argv[2] if len(sys.argv) > 2 else "127.0.0.1"
+    server = ThreadingHTTPServer((host, port), Handler)
+    print(f"action proxy listening on {host}:{port}", flush=True)
     server.serve_forever()
 
 

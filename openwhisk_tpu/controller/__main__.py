@@ -40,42 +40,46 @@ def main() -> None:
         logger = Logging(level="info")
         from ..utils.tracing import maybe_enable_zipkin
         zipkin = maybe_enable_zipkin(f"controller{args.instance}")
-        ExecManifest.initialize()
-        host, _, port = args.bus.partition(":")
-        provider = TcpMessagingProvider(host, int(port or 4222))
-        store = open_store(args.db)
-        instance = ControllerInstanceId(args.instance)
-        if args.balancer == "tpu":
-            from .loadbalancer.tpu_balancer import TpuBalancer
-            lb = TpuBalancer(provider, instance, logger=logger,
-                             metrics=logger.metrics,
-                             cluster_size=args.cluster_size)
-        else:
-            from .loadbalancer.sharding_balancer import ShardingBalancer
-            lb = ShardingBalancer(provider, instance, logger=logger,
-                                  metrics=logger.metrics,
-                                  cluster_size=args.cluster_size)
-        # namespace default limits via the CONFIG_whisk_limits_* env channel
-        # (ref: LIMITS_ACTIONS_INVOKES_* in ansible/roles/controller/deploy.yml)
-        lim = config_from_env().get("limits", {})
-        controller = Controller(
-            instance, provider, artifact_store=store, logger=logger,
-            load_balancer=lb,
-            invocations_per_minute=int(lim.get("invocations_per_minute", 60)),
-            concurrent_invocations=int(lim.get("concurrent_invocations", 30)),
-            fires_per_minute=int(lim.get("fires_per_minute", 60)))
-        if args.seed_guest:
-            from ..standalone import guest_identity
-            ident = guest_identity()
-            await controller.auth_store.put(
-                WhiskAuthRecord(ident.subject, [ident.namespace], [ident.authkey]))
-        await controller.start(host=args.host, port=args.port)
-        print(f"controller{args.instance} up on :{args.port} "
-              f"(balancer={args.balancer}, bus={args.bus})", flush=True)
+        controller = None
         try:
+            ExecManifest.initialize()
+            host, _, port = args.bus.partition(":")
+            provider = TcpMessagingProvider(host, int(port or 4222))
+            store = open_store(args.db)
+            instance = ControllerInstanceId(args.instance)
+            if args.balancer == "tpu":
+                from .loadbalancer.tpu_balancer import TpuBalancer
+                lb = TpuBalancer(provider, instance, logger=logger,
+                                 metrics=logger.metrics,
+                                 cluster_size=args.cluster_size)
+            else:
+                from .loadbalancer.sharding_balancer import ShardingBalancer
+                lb = ShardingBalancer(provider, instance, logger=logger,
+                                      metrics=logger.metrics,
+                                      cluster_size=args.cluster_size)
+            # namespace default limits via the CONFIG_whisk_limits_* env
+            # channel (ref: LIMITS_ACTIONS_INVOKES_* in
+            # ansible/roles/controller/deploy.yml)
+            lim = config_from_env().get("limits", {})
+            controller = Controller(
+                instance, provider, artifact_store=store, logger=logger,
+                load_balancer=lb,
+                invocations_per_minute=int(lim.get("invocations_per_minute", 60)),
+                concurrent_invocations=int(lim.get("concurrent_invocations", 30)),
+                fires_per_minute=int(lim.get("fires_per_minute", 60)))
+            if args.seed_guest:
+                from ..standalone import guest_identity
+                ident = guest_identity()
+                await controller.auth_store.put(
+                    WhiskAuthRecord(ident.subject, [ident.namespace],
+                                    [ident.authkey]))
+            await controller.start(host=args.host, port=args.port)
+            print(f"controller{args.instance} up on :{args.port} "
+                  f"(balancer={args.balancer}, bus={args.bus})", flush=True)
             await wait_for_shutdown()
         finally:
-            await controller.stop()
+            if controller is not None:
+                await controller.stop()
             if zipkin is not None:
                 await zipkin.close()
 

@@ -482,12 +482,13 @@ class ControllerApi:
             feed = trigger.annotations.get("feed")
             if feed is not None:
                 try:
-                    if not isinstance(feed, str) or \
-                            not 1 <= len(EntityPath(feed).segments) <= 3 or \
-                            (feed.startswith("/")
-                             and len(EntityPath(feed).segments) < 2):
-                        # a leading slash claims full qualification, which
-                        # needs at least namespace + action
+                    if not isinstance(feed, str):
+                        raise ValueError(feed)
+                    segs = EntityPath(feed).segments
+                    # a leading slash claims full qualification, which needs
+                    # at least namespace + action
+                    if not 1 <= len(segs) <= 3 or \
+                            (feed.startswith("/") and len(segs) < 2):
                         raise ValueError(feed)
                 except ValueError:
                     return _error(400, "Feed name is not valid",

@@ -41,33 +41,36 @@ def main() -> None:
         logger = Logging(level="info")
         from ..utils.tracing import maybe_enable_zipkin
         zipkin = maybe_enable_zipkin(f"invoker-{args.unique_name}")
-        ExecManifest.initialize()
-        host, _, port = args.bus.partition(":")
-        provider = TcpMessagingProvider(host, int(port or 4222))
-        store = open_store(args.db)
-        instance_id = await InstanceIdAssigner(store).assign(
-            args.unique_name, args.id)
-        instance = InvokerInstanceId(instance_id, unique_name=args.unique_name,
-                                     user_memory=MB(args.memory))
-        invoker = InvokerReactive(
-            instance, provider, EntityStore(store),
-            ArtifactActivationStore(store), ProcessContainerFactory(logger=logger),
-            pool_config=ContainerPoolConfig(user_memory=MB(args.memory),
-                                            pause_grace=1.0),
-            logger=logger)
-        await invoker.start(start_prewarm=args.prewarm)
-        server = None
-        if args.port:
-            server = InvokerServer(invoker, args.port)
-            await server.start()
-        print(f"invoker{instance_id} ({args.unique_name}) up — bus {args.bus}, "
-              f"memory {args.memory}MB", flush=True)
+        invoker = server = None
         try:
+            ExecManifest.initialize()
+            host, _, port = args.bus.partition(":")
+            provider = TcpMessagingProvider(host, int(port or 4222))
+            store = open_store(args.db)
+            instance_id = await InstanceIdAssigner(store).assign(
+                args.unique_name, args.id)
+            instance = InvokerInstanceId(instance_id,
+                                         unique_name=args.unique_name,
+                                         user_memory=MB(args.memory))
+            invoker = InvokerReactive(
+                instance, provider, EntityStore(store),
+                ArtifactActivationStore(store),
+                ProcessContainerFactory(logger=logger),
+                pool_config=ContainerPoolConfig(user_memory=MB(args.memory),
+                                                pause_grace=1.0),
+                logger=logger)
+            await invoker.start(start_prewarm=args.prewarm)
+            if args.port:
+                server = InvokerServer(invoker, args.port)
+                await server.start()
+            print(f"invoker{instance_id} ({args.unique_name}) up — "
+                  f"bus {args.bus}, memory {args.memory}MB", flush=True)
             await wait_for_shutdown()
         finally:
             if server:
                 await server.stop()
-            await invoker.stop()
+            if invoker is not None:
+                await invoker.stop()
             if zipkin is not None:
                 await zipkin.close()
 

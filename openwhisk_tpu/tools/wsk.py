@@ -182,6 +182,12 @@ async def run(args) -> int:
                                             f"/{ns}/{args.name}", auth,
                                             _params_to_dict(args.param))
                 if fs != 200:
+                    if fs == 202:
+                        # outcome unknown: the slow CREATE may yet succeed
+                        # provider-side, so best-effort tear it down before
+                        # the trigger document (its handle) disappears
+                        await _invoke_feed(client, args.feed, "DELETE",
+                                           f"/{ns}/{args.name}", auth, {})
                     await client.request(
                         "DELETE", f"/namespaces/{ns}/triggers/{args.name}")
                     print(f"error: feed action did not succeed ({fs}); "

@@ -76,6 +76,7 @@ class ZipkinReporter(Reporter):
         self.logger = logger
         self._pending: List[Span] = []
         self._flush_task: Optional[asyncio.Task] = None
+        self._flushing = False  # True only while a POST is in flight
         self._session = None  # lazily-created, kept for connection reuse
         self.sent_spans = 0
         self.dropped_spans = 0
@@ -90,16 +91,27 @@ class ZipkinReporter(Reporter):
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = loop.create_task(
                 self._flush_later(0.0 if full else self.flush_interval))
-        elif full:
+        elif full and not self._flushing:
             # a flush is scheduled but still sleeping out its interval —
-            # the batch is full NOW, so replace it with an immediate one
+            # the batch is full NOW, so replace it with an immediate one.
+            # A flush that is already mid-POST is never preempted: its
+            # backlog drains on the next flush once it completes.
             self._flush_task.cancel()
             self._flush_task = loop.create_task(self._flush_later(0.0))
 
     async def _flush_later(self, delay: float) -> None:
         if delay:
             await asyncio.sleep(delay)
-        await self.flush()
+        while True:
+            self._flushing = True
+            try:
+                await self.flush()
+            finally:
+                self._flushing = False
+            # a full batch accumulated during the POST: drain it now rather
+            # than waiting for the next report() to schedule a task
+            if len(self._pending) < self.batch_size:
+                return
 
     def _encode(self, spans: List[Span]) -> bytes:
         out = []

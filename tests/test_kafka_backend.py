@@ -160,7 +160,8 @@ def kafka_mod():
 class TestKafkaContract:
     def test_gated_when_library_absent(self):
         import openwhisk_tpu.messaging.kafka as kafka
-        assert not kafka.HAVE_KAFKA  # this image has no aiokafka
+        if kafka.HAVE_KAFKA:
+            pytest.skip("aiokafka installed: the gate is legitimately open")
         with pytest.raises(RuntimeError, match="no kafka client"):
             kafka.KafkaMessagingProvider()
 
