@@ -601,8 +601,8 @@ class TpuBalancer(CommonLoadBalancer):
         hidx, hval, hmask = self._health_arrays()
         self.state, chosen, forced = self._fused_fn(
             self.state, ri, rs, rm, rc, rv, hidx, hval, hmask, rb)
-        chosen_np = np.asarray(chosen)
-        forced_np = np.asarray(forced)
+        chosen_np, forced_np = await asyncio.to_thread(
+            lambda: (np.asarray(chosen), np.asarray(forced)))
         dt_ms = (time.monotonic() - t0) * 1e3
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)

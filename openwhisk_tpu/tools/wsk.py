@@ -186,8 +186,12 @@ async def run(args) -> int:
                         # outcome unknown: the slow CREATE may yet succeed
                         # provider-side, so best-effort tear it down before
                         # the trigger document (its handle) disappears
-                        await _invoke_feed(client, args.feed, "DELETE",
-                                           f"/{ns}/{args.name}", auth, {})
+                        try:
+                            await _invoke_feed(client, args.feed, "DELETE",
+                                               f"/{ns}/{args.name}", auth, {})
+                        except Exception as e:  # noqa: BLE001 — rollback must proceed
+                            print(f"warning: feed teardown attempt failed: {e}",
+                                  file=sys.stderr)
                     await client.request(
                         "DELETE", f"/namespaces/{ns}/triggers/{args.name}")
                     print(f"error: feed action did not succeed ({fs}); "
