@@ -601,9 +601,18 @@ class TpuBalancer(CommonLoadBalancer):
         hidx, hval, hmask = self._health_arrays()
         self.state, chosen, forced = self._fused_fn(
             self.state, ri, rs, rm, rc, rv, hidx, hval, hmask, rb)
-        chosen_np, forced_np = await asyncio.to_thread(
-            lambda: (np.asarray(chosen), np.asarray(forced)))
-        dt_ms = (time.monotonic() - t0) * 1e3
+
+        # readback on a worker thread: the event loop keeps serving acks,
+        # feeds and new publishes while the device (or tunnel) computes.
+        # The step lock is held, so no second step races the state. The
+        # step-duration stamp is taken ON the worker thread so the metric
+        # measures device step + readback, not loop re-scheduling delay.
+        def _read():
+            out = (np.asarray(chosen), np.asarray(forced))
+            return out, time.monotonic()
+
+        (chosen_np, forced_np), t_done = await asyncio.to_thread(_read)
+        dt_ms = (t_done - t0) * 1e3
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)
         for (_, fut, _), inv_idx, f in zip(batch, chosen_np, forced_np):

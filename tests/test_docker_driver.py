@@ -126,14 +126,23 @@ class TestDockerDriverExecutes:
         """The pool + proxy FSM driving the docker driver end to end: cold
         start then a warm hit on the same (real) container process."""
         async def go():
+            from openwhisk_tpu.containerpool import (ContainerPool,
+                                                     ContainerPoolConfig)
             from openwhisk_tpu.containerpool.pool import Run
             from tests.test_containerpool import (AckRecorder, make_msg,
-                                                  make_pool)
+                                                  make_proxy)
             from tests.test_containerpool import make_action as base_action
 
             factory = DockerContainerFactory()
             recorder = AckRecorder()
-            pool = make_pool(factory, recorder)
+            # generous pause_grace: with real SIGSTOP pause via subprocess,
+            # make_pool's 20 ms grace races the second Run against an
+            # in-flight docker pause under parallel-suite load
+            config = ContainerPoolConfig(user_memory=MB(1024),
+                                         pause_grace=10.0,
+                                         idle_container_timeout=60)
+            pool = ContainerPool(lambda: make_proxy(factory, recorder, config),
+                                 config, prewarm_config=[])
             action = base_action("dockact")
             action.exec.code = CODE  # real greeting body
 
