@@ -109,18 +109,26 @@ class DockerContainer(Container):
 
 
 class DockerContainerFactory(ContainerFactory):
-    def __init__(self, client: Optional[DockerClient] = None,
+    def __init__(self, invoker_name: str = "invoker0",
+                 client: Optional[DockerClient] = None,
                  network: str = "bridge", extra_args: Optional[List[str]] = None):
         if not docker_available():
             raise ContainerError("docker CLI not found on PATH")
         self.client = client or DockerClient()
         self.network = network
         self.extra_args = extra_args or []
+        # per-invoker name prefix (ref DockerContainerFactory.scala names
+        # containers wsk<id>_...): boot-time init()->cleanup() must reap
+        # only THIS invoker's leftovers, never a co-hosted invoker's live
+        # containers. Trailing '_' so "inv1" never prefix-matches "inv10".
+        safe = "".join(c if (c.isalnum() or c in "_.-") else "-"
+                       for c in invoker_name)
+        self.name_prefix = f"{NAME_PREFIX}_{safe}_"
 
     async def create_container(self, transid, name: str, image: str,
                                memory: ByteSize, cpu_shares: int = 0,
                                action=None) -> DockerContainer:
-        cname = f"{NAME_PREFIX}_{name}_{uuid.uuid4().hex[:8]}"
+        cname = f"{self.name_prefix}{name}_{uuid.uuid4().hex[:8]}"
         args = ["--name", cname, "--network", self.network,
                 "-m", f"{memory.to_mb}m", "--memory-swap", f"{memory.to_mb}m"]
         if cpu_shares:
@@ -131,8 +139,19 @@ class DockerContainerFactory(ContainerFactory):
         return DockerContainer(self.client, cid, ip, kind=image, memory=memory)
 
     async def cleanup(self) -> None:
-        for cid in await self.client.ps():
+        for cid in await self.client.ps(name_prefix=self.name_prefix):
             try:
                 await self.client.rm(cid)
             except ContainerError:
                 pass
+
+
+class DockerContainerFactoryProvider:
+    """ContainerFactoryProvider SPI binding
+    (CONFIG_whisk_spi_ContainerFactoryProvider=
+     openwhisk_tpu.containerpool.docker_factory:DockerContainerFactoryProvider)."""
+
+    @staticmethod
+    def instance(invoker_name: str = "invoker0", logger=None,
+                 **kwargs) -> DockerContainerFactory:
+        return DockerContainerFactory(invoker_name, **kwargs)

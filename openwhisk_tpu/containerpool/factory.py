@@ -38,7 +38,12 @@ class ContainerFactory:
         raise NotImplementedError
 
     async def init(self) -> None:
-        """Post-construction hook (prewarm cleanup etc.)."""
+        """Post-construction hook, run by the invoker at boot. Defaults to
+        reaping containers left over from a previous life (the reference
+        initializes its factory with a stale-container cleanup,
+        InvokerReactive.scala:129-147); drivers with a richer bootstrap
+        (e.g. YARN's service registration) override this."""
+        await self.cleanup()
 
     async def cleanup(self) -> None:
         """Remove any containers left over from a previous life

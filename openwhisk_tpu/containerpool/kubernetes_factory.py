@@ -290,9 +290,6 @@ class KubernetesContainerFactory(ContainerFactory):
         self.invoker_name = invoker_name
         self.builder = WhiskPodBuilder(self.config, invoker_name)
 
-    async def init(self) -> None:
-        await self.cleanup()
-
     async def create_container(self, transid, name: str, image: str,
                                memory: ByteSize, cpu_shares: int = 0,
                                action=None) -> KubernetesContainer:
@@ -326,3 +323,14 @@ class KubernetesContainerFactory(ContainerFactory):
     async def close(self) -> None:
         await self.cleanup()
         await self.client.close()
+
+
+class KubernetesContainerFactoryProvider:
+    """ContainerFactoryProvider SPI binding
+    (CONFIG_whisk_spi_ContainerFactoryProvider=
+     openwhisk_tpu.containerpool.kubernetes_factory:KubernetesContainerFactoryProvider)."""
+
+    @staticmethod
+    def instance(invoker_name: str = "invoker0", logger=None,
+                 **kwargs) -> KubernetesContainerFactory:
+        return KubernetesContainerFactory(invoker_name, **kwargs)

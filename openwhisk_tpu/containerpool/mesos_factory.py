@@ -154,3 +154,14 @@ class MesosContainerFactory(ContainerFactory):
             except (ContainerError, aiohttp.ClientError, OSError):
                 pass
         await self.client.close()
+
+
+class MesosContainerFactoryProvider:
+    """ContainerFactoryProvider SPI binding
+    (CONFIG_whisk_spi_ContainerFactoryProvider=
+     openwhisk_tpu.containerpool.mesos_factory:MesosContainerFactoryProvider)."""
+
+    @staticmethod
+    def instance(invoker_name: str = "invoker0", logger=None,
+                 **kwargs) -> MesosContainerFactory:
+        return MesosContainerFactory(invoker_name, **kwargs)

@@ -148,5 +148,8 @@ class ProcessContainerFactory(ContainerFactory):
 
 class ProcessContainerFactoryProvider:
     @staticmethod
-    def instance(logger=None, **kwargs) -> ProcessContainerFactory:
+    def instance(invoker_name: str = "invoker0", logger=None,
+                 **kwargs) -> ProcessContainerFactory:
+        # invoker_name is part of the uniform SPI signature; process
+        # sandboxes are per-instance, so it carries no state here
         return ProcessContainerFactory(logger=logger, **kwargs)

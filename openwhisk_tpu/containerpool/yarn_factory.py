@@ -237,3 +237,14 @@ class YARNContainerFactory(ContainerFactory):
     async def close(self) -> None:
         await self.cleanup()
         await self.client.close()
+
+
+class YARNContainerFactoryProvider:
+    """ContainerFactoryProvider SPI binding
+    (CONFIG_whisk_spi_ContainerFactoryProvider=
+     openwhisk_tpu.containerpool.yarn_factory:YARNContainerFactoryProvider)."""
+
+    @staticmethod
+    def instance(invoker_name: str = "invoker0", logger=None,
+                 **kwargs) -> YARNContainerFactory:
+        return YARNContainerFactory(invoker_name, **kwargs)

@@ -13,7 +13,6 @@ import argparse
 import asyncio
 
 from ..containerpool import ContainerPoolConfig
-from ..containerpool.process_factory import ProcessContainerFactory
 from ..core.entity import ExecManifest, InvokerInstanceId, MB
 from ..database import ArtifactActivationStore, EntityStore, open_store
 from ..messaging.tcp import TcpMessagingProvider
@@ -22,6 +21,15 @@ from .id_assigner import InstanceIdAssigner
 from .reactive import InvokerReactive
 from .server import InvokerServer
 from ..utils.tasks import wait_for_shutdown
+
+#: --container-factory shorthand -> SPI implementation path
+_FACTORY_SHORTHAND = {
+    "process": "openwhisk_tpu.containerpool.process_factory:ProcessContainerFactoryProvider",
+    "docker": "openwhisk_tpu.containerpool.docker_factory:DockerContainerFactoryProvider",
+    "kubernetes": "openwhisk_tpu.containerpool.kubernetes_factory:KubernetesContainerFactoryProvider",
+    "yarn": "openwhisk_tpu.containerpool.yarn_factory:YARNContainerFactoryProvider",
+    "mesos": "openwhisk_tpu.containerpool.mesos_factory:MesosContainerFactoryProvider",
+}
 
 
 def main() -> None:
@@ -35,6 +43,12 @@ def main() -> None:
     parser.add_argument("--memory", type=int, default=2048, help="user memory MB")
     parser.add_argument("--port", type=int, default=0, help="liveness /ping port")
     parser.add_argument("--prewarm", action="store_true")
+    parser.add_argument(
+        "--container-factory", default=None,
+        choices=("process", "docker", "kubernetes", "yarn", "mesos"),
+        help="container driver shorthand; without it the "
+             "ContainerFactoryProvider SPI resolves (default: process; "
+             "override via CONFIG_whisk_spi_ContainerFactoryProvider)")
     args = parser.parse_args()
 
     async def run():
@@ -52,10 +66,17 @@ def main() -> None:
             instance = InvokerInstanceId(instance_id,
                                          unique_name=args.unique_name,
                                          user_memory=MB(args.memory))
+            # container driver through the SPI seam (ref reference.conf
+            # ContainerFactoryProvider); the CLI shorthand binds it
+            from .. import spi
+            if args.container_factory:
+                spi.bind("ContainerFactoryProvider", _FACTORY_SHORTHAND[
+                    args.container_factory])
+            factory = spi.get("ContainerFactoryProvider").instance(
+                invoker_name=args.unique_name, logger=logger)
             invoker = InvokerReactive(
                 instance, provider, EntityStore(store),
-                ArtifactActivationStore(store),
-                ProcessContainerFactory(logger=logger),
+                ArtifactActivationStore(store), factory,
                 pool_config=ContainerPoolConfig(user_memory=MB(args.memory),
                                                 pause_grace=1.0),
                 logger=logger)

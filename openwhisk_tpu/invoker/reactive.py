@@ -75,6 +75,12 @@ class InvokerReactive:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, start_prewarm: bool = True) -> None:
+        # factory bootstrap: stale-container cleanup / service registration
+        # (ref InvokerReactive.scala:129-147); guarded for duck-typed test
+        # factories that skip the ContainerFactory base
+        init = getattr(self.factory, "init", None)
+        if init is not None:
+            await init()
         topic = self.instance.as_string
         self.provider.ensure_topic(topic)
         self.provider.ensure_topic(HEALTH_TOPIC,

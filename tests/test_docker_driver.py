@@ -112,6 +112,29 @@ class TestDockerDriverExecutes:
         left, alien_id = asyncio.run(go())
         assert left == [alien_id], "cleanup must reap exactly the prefixed set"
 
+    def test_cleanup_scoped_per_invoker(self, docker_env):
+        """Boot-time init()/cleanup() of one invoker must never reap a
+        co-hosted invoker's live containers (per-invoker name prefix)."""
+        async def go():
+            fac_a = DockerContainerFactory("inv-a")
+            fac_b = DockerContainerFactory("inv-b")
+            await _make(fac_a, "mine")
+            b = await _make(fac_b, "theirs")
+            await fac_a.init()  # the boot path that reaps leftovers
+            left = await DockerClient().ps(name_prefix="")
+            still_serves = False
+            try:
+                await b.initialize({"name": "x", "code": CODE,
+                                    "main": "main", "binary": False})
+                still_serves = (await b.run({"name": "b"}, {})).ok
+            finally:
+                await fac_b.cleanup()
+            return left, still_serves
+
+        left, still_serves = asyncio.run(go())
+        assert len(left) == 1, "inv-a's init must reap only inv-a's containers"
+        assert still_serves, "inv-b's container must still be alive and serving"
+
     def test_failed_image_surfaces_error(self, docker_env):
         async def go():
             factory = DockerContainerFactory()

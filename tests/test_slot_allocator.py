@@ -178,7 +178,11 @@ class TestBalancerSlotGrowth:
                               managed_fraction=1.0, blackbox_fraction=0.0,
                               action_slots=8, max_action_slots=16)
             await bal.start()
-            invokers, producer = await _fleet(provider, 4, delay=0.5)
+            # long ack delay: no key may release (and free its slot) while
+            # the 18 publishes are still queuing, or the later keys find
+            # recycled capacity instead of overflowing (the balancer now
+            # processes acks DURING device steps via the threaded readback)
+            invokers, producer = await _fleet(provider, 4, delay=2.5)
             await _ping_all(invokers, producer)
             ident = Identity.generate("guest")
             promises = []
