@@ -121,8 +121,16 @@ class DockerContainerFactory(ContainerFactory):
         # containers wsk<id>_...): boot-time init()->cleanup() must reap
         # only THIS invoker's leftovers, never a co-hosted invoker's live
         # containers. Trailing '_' so "inv1" never prefix-matches "inv10".
-        safe = "".join(c if (c.isalnum() or c in "_.-") else "-"
+        # `docker ps --filter name=` treats the value as an unanchored
+        # regex, so the prefix is whitelisted to regex-inert chars and, when
+        # sanitization lost information (e.g. 'inv:1' and 'inv/1' both map
+        # to 'inv-1'), a CRC of the raw name keeps distinct invokers from
+        # matching each other's containers.
+        safe = "".join(c if (c.isalnum() or c == "_") else "-"
                        for c in invoker_name)
+        if safe != invoker_name:
+            import zlib
+            safe += f"-{zlib.crc32(invoker_name.encode()) & 0xffff:04x}"
         self.name_prefix = f"{NAME_PREFIX}_{safe}_"
 
     async def create_container(self, transid, name: str, image: str,

@@ -359,11 +359,24 @@ class RemoteArtifactStoreProvider:
 
 def open_store(db: str) -> ArtifactStore:
     """Resolve a --db argument: `docstore://host:port` connects to a shared
-    DocStoreServer; anything else is a local sqlite path."""
+    DocStoreServer; `couchdb://host:port/dbname` (or couchdbs:// for TLS)
+    connects to a CouchDB server; anything else is a local sqlite path."""
     if db.startswith("docstore://"):
         hostport = db[len("docstore://"):]
         host, _, port = hostport.rpartition(":")
         return RemoteArtifactStore(host or "127.0.0.1", int(port))
+    if db.startswith(("couchdb://", "couchdbs://")):
+        from urllib.parse import urlsplit
+
+        from .couchdb_store import CouchDbArtifactStore
+        parts = urlsplit(db)
+        scheme = "https" if parts.scheme == "couchdbs" else "http"
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or (6984 if scheme == "https" else 5984)
+        return CouchDbArtifactStore(
+            f"{scheme}://{host}:{port}",
+            db=(parts.path.strip("/") or "whisks"),
+            username=parts.username, password=parts.password)
     from .sqlite_store import SqliteArtifactStore
     return SqliteArtifactStore(db)
 

@@ -1,0 +1,221 @@
+"""An in-process CouchDB fake, faithful to the wire surface
+CouchDbArtifactStore uses: database create, MVCC document CRUD (revision
+checks return real 409s), design-doc view queries with CouchDB array-key
+collation (startkey/endkey/descending/skip/limit/include_docs), and native
+attachments with per-operation revision bumps. State survives server
+restarts (the test harness restarts the HTTP front per event loop)."""
+from __future__ import annotations
+
+import json
+import uuid
+from urllib.parse import unquote
+
+from aiohttp import web
+
+
+def _rank(v):
+    if v is None:
+        return 0
+    if isinstance(v, bool):
+        return 1
+    if isinstance(v, (int, float)):
+        return 2
+    if isinstance(v, str):
+        return 3
+    if isinstance(v, list):
+        return 4
+    return 5  # objects sort last (CouchDB collation)
+
+
+def key_cmp(a, b) -> int:
+    """CouchDB view-key collation for the key shapes the store emits."""
+    if isinstance(a, list) and isinstance(b, list):
+        for x, y in zip(a, b):
+            c = key_cmp(x, y)
+            if c:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    ra, rb = _rank(a), _rank(b)
+    if ra != rb:
+        return (ra > rb) - (ra < rb)
+    if ra in (2, 3):
+        return (a > b) - (a < b)
+    return 0  # same-rank null/bool/object: equal for our key shapes
+
+
+class FakeCouchDB:
+    def __init__(self):
+        self.dbs = {}      # db -> {docid -> doc (with _rev, _attachments)}
+        self.blobs = {}    # (db, docid, att) -> bytes
+        self.runner = None
+
+    # ------------------------------------------------------------- lifecycle
+    async def start(self):
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self.dispatch)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{port}"
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+    # -------------------------------------------------------------- dispatch
+    async def dispatch(self, request: web.Request) -> web.Response:
+        raw = request.rel_url.raw_path.split("?")[0]
+        segs = [s for s in raw.split("/") if s]
+        if not segs:
+            return web.json_response({"couchdb": "fake"}, status=200)
+        db, rest = segs[0], segs[1:]
+        if not rest:
+            return await self.db_op(request, db)
+        docs = self.dbs.get(db)
+        if docs is None:
+            return web.json_response({"error": "not_found"}, status=404)
+        if rest[0] == "_design" and len(rest) >= 4 and rest[2] == "_view":
+            return self.view(request, db, unquote(rest[1]), rest[3])
+        if len(rest) == 1:
+            return await self.doc_op(request, db, unquote(rest[0]))
+        if len(rest) == 2:
+            return await self.att_op(request, db, unquote(rest[0]),
+                                     unquote(rest[1]))
+        return web.json_response({"error": "bad_request"}, status=400)
+
+    async def db_op(self, request, db):
+        if request.method == "PUT":
+            if db in self.dbs:
+                return web.json_response({"error": "file_exists"}, status=412)
+            self.dbs[db] = {}
+            return web.json_response({"ok": True}, status=201)
+        if request.method == "GET" and db in self.dbs:
+            return web.json_response({"db_name": db})
+        return web.json_response({"error": "not_found"}, status=404)
+
+    def _new_rev(self, old):
+        n = int(old.split("-")[0]) + 1 if old else 1
+        return f"{n}-{uuid.uuid4().hex[:8]}"
+
+    async def doc_op(self, request, db, doc_id):
+        docs = self.dbs[db]
+        if request.method == "GET":
+            doc = docs.get(doc_id)
+            if doc is None:
+                return web.json_response({"error": "not_found"}, status=404)
+            return web.json_response(doc)
+        if request.method == "PUT":
+            body = await request.json()
+            cur = docs.get(doc_id)
+            sent_rev = body.pop("_rev", None) or \
+                request.rel_url.query.get("rev")
+            if cur is not None and sent_rev != cur["_rev"]:
+                return web.json_response({"error": "conflict"}, status=409)
+            if cur is None and sent_rev is not None:
+                return web.json_response({"error": "conflict"}, status=409)
+            rev = self._new_rev(cur["_rev"] if cur else None)
+            body["_id"] = doc_id
+            body["_rev"] = rev
+            # REAL CouchDB semantics: a PUT whose body carries no
+            # _attachments stubs REMOVES existing attachments
+            if cur and "_attachments" in cur and "_attachments" not in body:
+                for key in [k for k in self.blobs
+                            if k[0] == db and k[1] == doc_id]:
+                    del self.blobs[key]
+            docs[doc_id] = body
+            return web.json_response({"ok": True, "id": doc_id, "rev": rev},
+                                     status=201)
+        if request.method == "DELETE":
+            cur = docs.get(doc_id)
+            if cur is None:
+                return web.json_response({"error": "not_found"}, status=404)
+            if request.rel_url.query.get("rev") != cur["_rev"]:
+                return web.json_response({"error": "conflict"}, status=409)
+            del docs[doc_id]
+            for key in [k for k in self.blobs if k[0] == db and k[1] == doc_id]:
+                del self.blobs[key]
+            return web.json_response({"ok": True}, status=200)
+        return web.json_response({"error": "method_not_allowed"}, status=405)
+
+    async def att_op(self, request, db, doc_id, att):
+        docs = self.dbs[db]
+        cur = docs.get(doc_id)
+        if request.method == "GET":
+            blob = self.blobs.get((db, doc_id, att))
+            if cur is None or blob is None:
+                return web.json_response({"error": "not_found"}, status=404)
+            ct = cur.get("_attachments", {}).get(att, {}).get(
+                "content_type", "application/octet-stream")
+            return web.Response(body=blob, content_type=ct)
+        if cur is None:
+            return web.json_response({"error": "not_found"}, status=404)
+        if request.rel_url.query.get("rev") != cur["_rev"]:
+            return web.json_response({"error": "conflict"}, status=409)
+        if request.method == "PUT":
+            data = await request.read()
+            cur.setdefault("_attachments", {})[att] = {
+                "content_type": request.content_type,
+                "length": len(data), "stub": True}
+            self.blobs[(db, doc_id, att)] = data
+            cur["_rev"] = self._new_rev(cur["_rev"])
+            return web.json_response({"ok": True, "rev": cur["_rev"]},
+                                     status=201)
+        if request.method == "DELETE":
+            cur.get("_attachments", {}).pop(att, None)
+            if not cur.get("_attachments"):
+                cur.pop("_attachments", None)
+            self.blobs.pop((db, doc_id, att), None)
+            cur["_rev"] = self._new_rev(cur["_rev"])
+            return web.json_response({"ok": True, "rev": cur["_rev"]},
+                                     status=200)
+        return web.json_response({"error": "method_not_allowed"}, status=405)
+
+    def view(self, request, db, design, view):
+        design_doc = self.dbs[db].get(f"_design/{design}")
+        if design_doc is None or view not in design_doc.get("views", {}):
+            return web.json_response({"error": "not_found"}, status=404)
+        q = request.rel_url.query
+        # native implementation of the `all` map function the store installs
+        rows = []
+        for doc_id, doc in self.dbs[db].items():
+            if doc_id.startswith("_design/"):
+                continue
+            if not doc.get("entityType"):
+                continue
+            ns = str(doc.get("namespace", "")).split("/")[0]
+            key = [doc["entityType"], ns,
+                   doc.get("start") or doc.get("updated") or 0]
+            rows.append({"id": doc_id, "key": key, "value": None,
+                         "doc": doc})
+        rows.sort(key=lambda r: _SortKey(r["key"]))
+        descending = q.get("descending") == "true"
+        if descending:
+            rows.reverse()
+        start = json.loads(q["startkey"]) if "startkey" in q else None
+        end = json.loads(q["endkey"]) if "endkey" in q else None
+        if start is not None:
+            rows = [r for r in rows
+                    if (key_cmp(r["key"], start) >= 0 if not descending
+                        else key_cmp(r["key"], start) <= 0)]
+        if end is not None:
+            rows = [r for r in rows
+                    if (key_cmp(r["key"], end) <= 0 if not descending
+                        else key_cmp(r["key"], end) >= 0)]
+        rows = rows[int(q.get("skip", 0)):]
+        if "limit" in q:
+            rows = rows[: int(q["limit"])]
+        if q.get("include_docs") != "true":
+            for r in rows:
+                r.pop("doc", None)
+        return web.json_response({"total_rows": len(rows), "rows": rows})
+
+
+class _SortKey:
+    __slots__ = ("k",)
+
+    def __init__(self, k):
+        self.k = k
+
+    def __lt__(self, other):
+        return key_cmp(self.k, other.k) < 0

@@ -56,13 +56,65 @@ class _RemoteStoreFixture:
         return call
 
 
-@pytest.fixture(params=["memory", "sqlite", "remote"])
+class _CouchFixture:
+    """FakeCouchDB + CouchDbArtifactStore per test event loop; the fake's
+    document state persists across loops like a real server would."""
+
+    def __init__(self):
+        from tests.fake_couchdb import FakeCouchDB
+        self._fake = FakeCouchDB()
+        self._loop = None
+        self._client = None
+
+    async def _store(self):
+        from openwhisk_tpu.database.couchdb_store import CouchDbArtifactStore
+        loop = asyncio.get_running_loop()
+        if self._loop is not loop:
+            url = await self._fake.start()
+            self._client = CouchDbArtifactStore(url, db="whisks")
+            self._loop = loop
+        return self._client
+
+    def __getattr__(self, name):
+        async def call(*args, **kwargs):
+            return await getattr(await self._store(), name)(*args, **kwargs)
+        return call
+
+    def teardown(self):
+        """Best-effort close of the client session + fake server sockets
+        (their event loop is already gone — suppress loop-affinity errors
+        rather than leak listeners/sessions for the rest of the run)."""
+        async def _close():
+            try:
+                if self._client is not None:
+                    await self._client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                if self._fake.runner is not None:
+                    await self._fake.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            asyncio.run(_close())
+        except Exception:  # noqa: BLE001
+            pass
+
+
+@pytest.fixture(params=["memory", "sqlite", "remote", "couchdb"])
 def store(request, tmp_path):
     if request.param == "memory":
-        return MemoryArtifactStore()
+        yield MemoryArtifactStore()
+        return
     if request.param == "remote":
-        return _RemoteStoreFixture(str(tmp_path / "remote.db"))
-    return SqliteArtifactStore(str(tmp_path / "whisks.db"))
+        yield _RemoteStoreFixture(str(tmp_path / "remote.db"))
+        return
+    if request.param == "couchdb":
+        fx = _CouchFixture()
+        yield fx
+        fx.teardown()
+        return
+    yield SqliteArtifactStore(str(tmp_path / "whisks.db"))
 
 
 class TestArtifactStoreContract:
