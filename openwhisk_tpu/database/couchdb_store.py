@@ -118,13 +118,15 @@ class CouchDbArtifactStore(ArtifactStore):
         if rev is not None:
             body["_rev"] = rev
         async with self._http().put(self._doc_url(doc_id), json=body) as resp:
-            data = await resp.json(content_type=None)
             if resp.status in (201, 202):
-                return data["rev"]
+                return (await resp.json(content_type=None))["rev"]
             if resp.status == 409:
                 raise DocumentConflict(doc_id)
+            # a proxy/LB 5xx may carry HTML: never let a decode error mask
+            # the real failure
             raise ArtifactStoreException(
-                f"put {doc_id} failed ({resp.status}): {data}")
+                f"put {doc_id} failed ({resp.status}): "
+                f"{(await resp.text())[:256]}")
 
     async def get(self, doc_id: str) -> Dict[str, Any]:
         await self._ensure_once()
@@ -165,20 +167,20 @@ class CouchDbArtifactStore(ArtifactStore):
             pass  # best-effort GC; a racing writer just recreates it
 
     # -- views -------------------------------------------------------------
-    async def _view_rows(self, collection: str, namespace: Optional[str],
+    async def _view_rows(self, collection: str, ns_root: Optional[str],
                          since: Optional[float], upto: Optional[float],
                          skip: int, limit: int, descending: bool,
                          include_docs: bool,
                          pushdown_paging: bool) -> List[Dict[str, Any]]:
-        """One /_view/all range read. When `namespace` is None a single
-        [collection, ns, ts] key range cannot bound the timestamp (ns varies
-        in the middle of the key), so the ts filter — and therefore paging —
-        runs client-side over the row keys."""
+        """One /_view/all range read over [collection, root-namespace, ts]
+        keys. When `ns_root` is None a single key range cannot bound the
+        timestamp (ns varies mid-key), so the ts filter — and therefore
+        paging — runs client-side over the row keys."""
         await self._ensure_once()
-        cross_ns = namespace is None
-        lo = [collection, "" if cross_ns else namespace,
+        cross_ns = ns_root is None
+        lo = [collection, "" if cross_ns else ns_root,
               0 if cross_ns or since is None else since]
-        hi = [collection, _MAX if cross_ns else namespace,
+        hi = [collection, _MAX if cross_ns else ns_root,
               _MAX if cross_ns or upto is None else upto]
         params = {
             "include_docs": "true" if include_docs else "false",
@@ -188,8 +190,7 @@ class CouchDbArtifactStore(ArtifactStore):
             "startkey": json.dumps(hi if descending else lo),
             "endkey": json.dumps(lo if descending else hi),
         }
-        pushdown_paging = pushdown_paging and not cross_ns
-        if pushdown_paging:
+        if pushdown_paging and not cross_ns:
             if skip:
                 params["skip"] = str(skip)
             if limit:
@@ -206,8 +207,6 @@ class CouchDbArtifactStore(ArtifactStore):
             rows = [r for r in rows
                     if (since is None or r["key"][2] >= since)
                     and (upto is None or r["key"][2] <= upto)]
-            if pushdown_paging is False and (skip or limit):
-                pass  # caller pages client-side
         return rows
 
     async def query(self, collection: str, namespace: Optional[str] = None,
@@ -215,18 +214,26 @@ class CouchDbArtifactStore(ArtifactStore):
                     since: Optional[float] = None, upto: Optional[float] = None,
                     skip: int = 0, limit: int = 0,
                     descending: bool = True) -> List[Dict[str, Any]]:
-        # name filtering happens client-side (the reference has dedicated
-        # byName views; one view + filter keeps the design doc minimal), so
-        # paging pushes down only when there is no client-side filter
-        pushdown = name is None
-        rows = await self._view_rows(collection, namespace, since, upto,
+        # the view keys carry only the ROOT namespace, so a package-
+        # qualified query ('ns/pkg') reads the root's range and narrows
+        # client-side; name filtering is also client-side (the reference
+        # has dedicated byName views; one view + filter keeps the design
+        # doc minimal). Paging pushes down only without client-side filters.
+        ns_root = namespace.split("/")[0] if namespace is not None else None
+        packaged = namespace is not None and "/" in namespace
+        pushdown = name is None and not packaged and namespace is not None
+        rows = await self._view_rows(collection, ns_root, since, upto,
                                      skip, limit, descending,
                                      include_docs=True,
                                      pushdown_paging=pushdown)
         docs = [row["doc"] for row in rows if row.get("doc") is not None]
+        if packaged:
+            docs = [d for d in docs
+                    if str(d.get("namespace", "")) == namespace
+                    or str(d.get("namespace", "")).startswith(namespace + "/")]
         if name is not None:
             docs = [d for d in docs if d.get("name") == name]
-        if not pushdown or namespace is None:
+        if not pushdown:
             docs = docs[skip:] if skip else docs
             docs = docs[:limit] if limit else docs
         return docs
@@ -235,7 +242,8 @@ class CouchDbArtifactStore(ArtifactStore):
                     name: Optional[str] = None,
                     since: Optional[float] = None, upto: Optional[float] = None
                     ) -> int:
-        if name is not None:
+        if name is not None or (namespace is not None and "/" in namespace):
+            # client-side filters need document bodies
             return len(await self.query(collection, namespace, name,
                                         since, upto))
         # keys alone carry the timestamp: no document bodies on the wire
@@ -247,7 +255,10 @@ class CouchDbArtifactStore(ArtifactStore):
     # -- attachments (sidecar doc: see module docstring) -------------------
     @staticmethod
     def _att_doc_id(doc_id: str) -> str:
-        return f"att/{doc_id}"
+        # ':' cannot appear in entity ids (ENTITY_NAME_RX excludes it), so
+        # the sidecar namespace can never collide with a real document —
+        # 'att/{id}' WOULD collide with entities of a user namespace 'att'
+        return f"att:{doc_id}"
 
     async def attach(self, doc_id: str, name: str, content_type: str,
                      data: bytes) -> None:
@@ -289,24 +300,39 @@ class CouchDbArtifactStore(ArtifactStore):
                                  except_name: Optional[str] = None) -> None:
         await self._ensure_once()
         sid = self._att_doc_id(doc_id)
-        try:
-            sidecar = await self.get(sid)
-        except NoDocumentException:
-            return
-        rev = sidecar["_rev"]
-        remaining = dict(sidecar.get("_attachments", {}))
-        for att in list(remaining):
-            if att == except_name:
-                continue
-            async with self._http().delete(
-                    self._doc_url(sid, att), params={"rev": rev}) as resp:
-                if resp.status in (200, 202):
-                    rev = (await resp.json(content_type=None))["rev"]
-                    remaining.pop(att)
-        if not remaining:
-            async with self._http().delete(self._doc_url(sid),
-                                           params={"rev": rev}):
-                pass  # empty sidecar GC, best-effort
+        for _ in range(5):  # rev races with concurrent attachers: re-read
+            try:
+                sidecar = await self.get(sid)
+            except NoDocumentException:
+                return
+            rev = sidecar["_rev"]
+            doomed = [a for a in sidecar.get("_attachments", {})
+                      if a != except_name]
+            if not doomed:
+                if except_name is None or not sidecar.get("_attachments"):
+                    async with self._http().delete(
+                            self._doc_url(sid), params={"rev": rev}) as resp:
+                        if resp.status == 409:
+                            continue  # a late attacher revived it — retry
+                return
+            for att in doomed:
+                async with self._http().delete(
+                        self._doc_url(sid, att), params={"rev": rev}) as resp:
+                    if resp.status in (200, 202):
+                        rev = (await resp.json(content_type=None))["rev"]
+                    elif resp.status == 404:
+                        pass  # already gone
+                    elif resp.status == 409:
+                        break  # rev moved under us: re-read and retry
+                    else:
+                        raise ArtifactStoreException(
+                            f"delete attachment {doc_id}/{att} failed "
+                            f"({resp.status})")
+            # loop re-reads: verifies deletions stuck, retries conflicts,
+            # and GCs the now-empty sidecar
+        else:
+            raise DocumentConflict(
+                f"attachments of {doc_id}: persistent revision conflicts")
 
     async def close(self) -> None:
         await super().close()

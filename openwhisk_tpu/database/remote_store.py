@@ -366,17 +366,20 @@ def open_store(db: str) -> ArtifactStore:
         host, _, port = hostport.rpartition(":")
         return RemoteArtifactStore(host or "127.0.0.1", int(port))
     if db.startswith(("couchdb://", "couchdbs://")):
-        from urllib.parse import urlsplit
+        from urllib.parse import unquote, urlsplit
 
         from .couchdb_store import CouchDbArtifactStore
         parts = urlsplit(db)
         scheme = "https" if parts.scheme == "couchdbs" else "http"
         host = parts.hostname or "127.0.0.1"
         port = parts.port or (6984 if scheme == "https" else 5984)
+        # urlsplit does NOT percent-decode userinfo; credentials with
+        # reserved chars (@ : /) arrive encoded and must be restored
         return CouchDbArtifactStore(
             f"{scheme}://{host}:{port}",
             db=(parts.path.strip("/") or "whisks"),
-            username=parts.username, password=parts.password)
+            username=unquote(parts.username) if parts.username else None,
+            password=unquote(parts.password) if parts.password else None)
     from .sqlite_store import SqliteArtifactStore
     return SqliteArtifactStore(db)
 

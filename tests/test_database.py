@@ -166,6 +166,11 @@ class TestArtifactStoreContract:
                                          "name": "c", "updated": 20})
             docs = await store.query("actions", "ns")
             assert docs[0]["name"] == "c"
+            # and a package-QUALIFIED namespace lists only that package
+            # (api.py lists package contents with 'ns/pkg')
+            docs = await store.query("actions", "ns/pkg")
+            assert [d["name"] for d in docs] == ["c"]
+            assert await store.count("actions", "ns/pkg") == 1
         run(go())
 
     def test_attachments(self, store):
