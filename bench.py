@@ -2,8 +2,9 @@
 
 Measures the steady-state rate of the balancer's device step — ONE fused
 program (ops.placement.make_fused_step: previous batch's release fold +
-health fold + a B=256 schedule) over a 1024-invoker fleet, exactly the
-program TpuBalancer._device_step dispatches per micro-batch. Books are held
+health fold + a B=256 schedule) over the fleet size given by `--fleet`
+(default 1024; the north-star config is 65536), exactly the program
+TpuBalancer._device_step dispatches per micro-batch. Books are held
 constant (each step releases the prior step's placements) so the loop runs
 indefinitely.
 
@@ -320,6 +321,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--kernel", choices=("xla", "pallas", "both"),
                     default="both")
+    ap.add_argument("--fleet", type=int, default=N_INVOKERS,
+                    help="invoker count for the kernel stages (the "
+                         "north-star config is 65536)")
     ap.add_argument("--quick", action="store_true",
                     help="skip the balancer-level benchmark")
     ap.add_argument("--sweep", action="store_true",
@@ -334,9 +338,17 @@ def main() -> None:
 
     kernels = {}
     if args.kernel in ("xla", "both"):
-        kernels["xla"] = _bench_kernel("xla")
+        kernels["xla"] = _bench_kernel("xla", n_invokers=args.fleet)
     if args.kernel in ("pallas", "both"):
-        kernels["pallas"] = _bench_kernel("pallas")
+        from openwhisk_tpu.ops.placement_pallas import fits_vmem
+        if fits_vmem(args.fleet, 256):
+            kernels["pallas"] = _bench_kernel("pallas",
+                                              n_invokers=args.fleet)
+        else:
+            print(f"# pallas skipped: {args.fleet}x256 exceeds the VMEM "
+                  "budget (XLA path covers large fleets)", file=sys.stderr)
+            if args.kernel == "pallas":
+                kernels["xla"] = _bench_kernel("xla", n_invokers=args.fleet)
 
     parity_ok = _parity_check() if args.kernel == "both" else None
 
