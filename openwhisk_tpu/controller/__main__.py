@@ -34,13 +34,19 @@ def main() -> None:
     parser.add_argument("--balancer", choices=("tpu", "sharding"), default="tpu")
     parser.add_argument("--seed-guest", action="store_true",
                         help="create the standalone guest identity")
+    parser.add_argument("--balancer-snapshot", default=None,
+                        help="path for periodic balancer-state snapshots; "
+                             "restored at boot to skip the warm-up window "
+                             "(SURVEY §5.4 checkpoint/resume)")
+    parser.add_argument("--balancer-snapshot-interval", type=float,
+                        default=10.0)
     args = parser.parse_args()
 
     async def run():
         logger = Logging(level="info")
         from ..utils.tracing import maybe_enable_zipkin
         zipkin = maybe_enable_zipkin(f"controller{args.instance}")
-        controller = None
+        controller = snapshotter = None
         try:
             ExecManifest.initialize()
             host, _, port = args.bus.partition(":")
@@ -57,6 +63,14 @@ def main() -> None:
                 lb = ShardingBalancer(provider, instance, logger=logger,
                                       metrics=logger.metrics,
                                       cluster_size=args.cluster_size)
+            if args.balancer_snapshot:
+                from .loadbalancer.checkpoint import (BalancerSnapshotter,
+                                                      load_snapshot)
+                load_snapshot(lb, args.balancer_snapshot, logger,
+                              cluster_size=args.cluster_size)
+                snapshotter = BalancerSnapshotter(
+                    lb, args.balancer_snapshot,
+                    args.balancer_snapshot_interval, logger).start()
             # namespace default limits via the CONFIG_whisk_limits_* env
             # channel (ref: LIMITS_ACTIONS_INVOKES_* in
             # ansible/roles/controller/deploy.yml)
@@ -78,6 +92,8 @@ def main() -> None:
                   f"(balancer={args.balancer}, bus={args.bus})", flush=True)
             await wait_for_shutdown()
         finally:
+            if snapshotter is not None:
+                await snapshotter.stop()
             if controller is not None:
                 await controller.stop()
             if zipkin is not None:

@@ -1,0 +1,132 @@
+"""Balancer checkpoint/resume (SURVEY §5.4).
+
+The balancer's scheduling state is soft — reconstructible from pings and
+acks — so its whole durability story is a periodic host-side snapshot of
+the device capacity matrix plus registry/slot bookkeeping
+(TpuBalancer.snapshot()/restore()). This module wires that into the
+service lifecycle: restore at boot (skipping the warm-up window where
+in-flight holds would otherwise be forgotten and capacity double-booked
+until forced-timeout self-healing catches up), then an atomic periodic
+dump. Reference posture: no ML checkpointing exists; controller caches
+rebuild cold (SURVEY §5.4) — the snapshot is strictly an optimization,
+so every failure path here degrades to a cold start, never an abort.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+from typing import Optional
+
+from ...utils.scheduler import Scheduler
+
+
+def load_snapshot(balancer, path: str, logger=None,
+                  cluster_size: Optional[int] = None) -> bool:
+    """Restore at boot; returns True on success. A missing, corrupt, or
+    incompatible snapshot means a cold start — never a boot failure.
+    `cluster_size` is the OPERATOR's current topology: a stale snapshot
+    from a different cluster size must not override it (re-sharding resets
+    in-flight holds, exactly as a live membership change would)."""
+    if not hasattr(balancer, "restore"):
+        if logger:
+            logger.warn(None, f"balancer snapshotting requested but "
+                              f"{type(balancer).__name__} keeps no "
+                              "snapshotable state; ignoring")
+        return False
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except FileNotFoundError:
+        return False
+    except (OSError, json.JSONDecodeError) as e:
+        if logger:
+            logger.warn(None, f"balancer snapshot {path} unreadable "
+                              f"({e}); cold start")
+        return False
+    try:
+        balancer.restore(snap)
+    except Exception as e:  # noqa: BLE001 — incompatible snapshot: cold start
+        if logger:
+            logger.warn(None, f"balancer snapshot {path} not restorable "
+                              f"({e}); cold start")
+        return False
+    if cluster_size is not None and \
+            getattr(balancer, "cluster_size", cluster_size) != cluster_size:
+        if logger:
+            logger.warn(None, f"snapshot carries cluster_size="
+                              f"{balancer.cluster_size}, topology says "
+                              f"{cluster_size}: re-sharding (holds reset)")
+        balancer.update_cluster(cluster_size)
+    if logger:
+        logger.info(None, f"balancer state restored from {path} "
+                          f"({len(snap.get('registry', []))} invokers)")
+    return True
+
+
+def write_snapshot(balancer, path: str, parts: Optional[dict] = None) -> None:
+    """Atomic dump: write-temp + rename, so a crash mid-write can never
+    leave a torn snapshot for the next boot. With `parts` (captured on the
+    event loop via snapshot_parts) this is safe to run on a worker
+    thread."""
+    snap = balancer.snapshot(parts) if parts is not None \
+        else balancer.snapshot()
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".balancer-snap-", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class BalancerSnapshotter:
+    """Periodic snapshot loop for a service process."""
+
+    def __init__(self, balancer, path: str, interval: float = 10.0,
+                 logger=None):
+        self.balancer = balancer
+        self.path = path
+        self.interval = interval
+        self.logger = logger
+        self._scheduler: Optional[Scheduler] = None
+
+    def start(self) -> "BalancerSnapshotter":
+        if hasattr(self.balancer, "snapshot"):
+            self._scheduler = Scheduler(
+                self.interval, self._dump, logger=self.logger,
+                initial_delay=self.interval,
+                name="balancer-snapshotter").start()
+        elif self.logger:
+            self.logger.warn(None, f"balancer snapshotting requested but "
+                                   f"{type(self.balancer).__name__} keeps "
+                                   "no snapshotable state; ignoring")
+        return self
+
+    async def _dump(self) -> None:
+        # capture on the loop (consistent device-state ref + host-book
+        # copies), then do the device->host transfer + serialize + write on
+        # a worker thread — at the 64k north-star fleet the dump must not
+        # stall the 2 ms batch-window data plane
+        parts = self.balancer.snapshot_parts()
+        await asyncio.to_thread(write_snapshot, self.balancer, self.path,
+                                parts)
+
+    async def stop(self, final_dump: bool = True) -> None:
+        if self._scheduler is not None:
+            await self._scheduler.stop()
+        if final_dump and hasattr(self.balancer, "snapshot"):
+            try:
+                write_snapshot(self.balancer, self.path)
+            except Exception as e:  # noqa: BLE001 — shutdown must proceed;
+                # a broken device during an exceptional teardown must not
+                # mask the original error or skip sibling cleanup
+                if self.logger:
+                    self.logger.warn(None, f"final balancer snapshot "
+                                           f"failed: {e}")

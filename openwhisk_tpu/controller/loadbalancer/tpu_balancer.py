@@ -447,27 +447,39 @@ class TpuBalancer(CommonLoadBalancer):
         return self.supervision.health()
 
     # -- checkpoint / resume (SURVEY §5.4) ---------------------------------
-    def snapshot(self) -> dict:
-        """Host-side snapshot of the device capacity matrix + registry. The
-        balancer state is soft (reconstructible from pings/acks), so this is
-        the whole checkpoint story: dump it periodically, restore on boot to
-        skip the warm-up window."""
-        conc = np.asarray(self.state.conc_free)
-        nz = np.nonzero(conc)
+    def snapshot_parts(self) -> dict:
+        """Event-loop-side capture for a snapshot: ONE consistent reference
+        to the (immutable) device state plus copies of the host books. The
+        heavy device->host transfer can then run on a worker thread
+        (checkpoint.BalancerSnapshotter) without racing loop mutations or
+        mixing books from different device steps."""
         return {
+            "state": self.state,
             "n_pad": self._n_pad,
             "cluster_size": self._cluster_size,
             "action_slots": self.action_slots,
             "registry": [inv.to_json() for inv in self._registry],
             "healthy": list(self._healthy),
-            "free_mb": np.asarray(self.state.free_mb).tolist(),
-            "conc_nonzero": [[int(i), int(j), int(conc[i, j])]
-                             for i, j in zip(*nz)],
             "slots": dict(self._slots.slots),
             "slot_refcount": dict(self._slots.refcount),
             "slot_overflow": {k: list(v)
                               for k, v in self._slots.overflow.items()},
         }
+
+    def snapshot(self, parts: Optional[dict] = None) -> dict:
+        """Host-side snapshot of the device capacity matrix + registry. The
+        balancer state is soft (reconstructible from pings/acks), so this is
+        the whole checkpoint story: dump it periodically, restore on boot to
+        skip the warm-up window. Thread-safe given `parts` from
+        snapshot_parts()."""
+        parts = parts if parts is not None else self.snapshot_parts()
+        state = parts.pop("state")
+        conc = np.asarray(state.conc_free)
+        nz = np.nonzero(conc)
+        parts["free_mb"] = np.asarray(state.free_mb).tolist()
+        parts["conc_nonzero"] = [[int(i), int(j), int(conc[i, j])]
+                                 for i, j in zip(*nz)]
+        return parts
 
     def restore(self, snap: dict) -> None:
         self._n_pad = int(snap["n_pad"])

@@ -1,0 +1,147 @@
+"""Balancer checkpoint/resume lifecycle (SURVEY §5.4): periodic atomic
+snapshots of the device capacity matrix + registry, restored at boot; every
+failure path degrades to a cold start, never a boot abort."""
+import asyncio
+import json
+import os
+
+from openwhisk_tpu.controller.loadbalancer import ShardingBalancer, TpuBalancer
+from openwhisk_tpu.controller.loadbalancer.checkpoint import (
+    BalancerSnapshotter, load_snapshot, write_snapshot)
+from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+
+from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+
+def _balancer(provider, instance="0"):
+    return TpuBalancer(provider, ControllerInstanceId(instance),
+                       managed_fraction=1.0, blackbox_fraction=0.0)
+
+
+class TestSnapshotRoundtrip:
+    def test_write_restore_preserves_in_flight_books(self, tmp_path):
+        path = str(tmp_path / "bal.snap")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4, delay=1.0)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("held", memory=256)
+            promises = [await bal.publish(action, make_msg(action, ident, True))
+                        for _ in range(4)]  # 4 in-flight holds
+            write_snapshot(bal, path)
+
+            cold = _balancer(provider, "1")
+            assert load_snapshot(cold, path) is True
+            import numpy as np
+            same_free = np.array_equal(np.asarray(cold.state.free_mb),
+                                       np.asarray(bal.state.free_mb))
+            same_conc = np.array_equal(np.asarray(cold.state.conc_free),
+                                       np.asarray(bal.state.conc_free))
+            regs = [i.instance for i in cold._registry]
+            await asyncio.gather(*[asyncio.wait_for(p, 5) for p in promises])
+            await bal.close()
+            await cold.close()
+            for inv in invokers:
+                await inv.stop()
+            return same_free, same_conc, regs
+
+        same_free, same_conc, regs = asyncio.run(go())
+        assert same_free, "restored memory books must match (holds included)"
+        assert same_conc, "restored concurrency books must match"
+        assert regs == [0, 1, 2, 3]
+
+    def test_missing_and_corrupt_snapshots_cold_start(self, tmp_path):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            assert load_snapshot(bal, str(tmp_path / "nope")) is False
+            bad = tmp_path / "bad.snap"
+            bad.write_text("{not json")
+            assert load_snapshot(bal, str(bad)) is False
+            # structurally-wrong JSON must not abort boot either
+            ugly = tmp_path / "ugly.snap"
+            ugly.write_text(json.dumps({"n_pad": "wat"}))
+            assert load_snapshot(bal, str(ugly)) is False
+            await bal.close()
+
+        asyncio.run(go())
+
+    def test_stale_cluster_size_yields_to_topology(self, tmp_path):
+        """A snapshot from a 1-controller deployment restored into a
+        2-controller topology must re-shard to the OPERATOR's cluster size
+        (holds reset, as on a live membership change), never double-book."""
+        path = str(tmp_path / "stale.snap")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            write_snapshot(bal, path)  # cluster_size=1 inside
+            cold = _balancer(provider, "1")
+            assert load_snapshot(cold, path, cluster_size=2) is True
+            import numpy as np
+            shares = np.asarray(cold.state.free_mb)[:2]
+            await bal.close()
+            await cold.close()
+            for inv in invokers:
+                await inv.stop()
+            return cold.cluster_size, shares.tolist()
+
+        cs, shares = asyncio.run(go())
+        assert cs == 2, "topology wins over the stale snapshot"
+        assert shares == [1024, 1024], \
+            "per-invoker share must be re-divided by the real cluster size"
+
+    def test_non_checkpointable_balancer_noops(self, tmp_path):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = ShardingBalancer(provider, ControllerInstanceId("0"))
+            assert not hasattr(bal, "restore")
+            assert load_snapshot(bal, str(tmp_path / "x")) is False
+            snap = BalancerSnapshotter(bal, str(tmp_path / "x"), 0.01).start()
+            await asyncio.sleep(0.05)
+            await snap.stop()
+            assert not os.path.exists(tmp_path / "x")
+            await bal.close()
+
+        asyncio.run(go())
+
+
+class TestSnapshotter:
+    def test_periodic_and_final_dump(self, tmp_path):
+        path = str(tmp_path / "periodic.snap")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            snap = BalancerSnapshotter(bal, path, interval=0.05).start()
+            for _ in range(100):
+                if os.path.exists(path):
+                    break
+                await asyncio.sleep(0.02)
+            periodic = os.path.exists(path)
+            first = json.load(open(path)) if periodic else None
+            # fleet grows; the FINAL dump at stop must capture it
+            inv3, producer = await _fleet(provider, 4)
+            await _ping_all(inv3, producer)
+            await snap.stop()
+            final = json.load(open(path))
+            await bal.close()
+            for inv in invokers + inv3:
+                await inv.stop()
+            return periodic, first, final
+
+        periodic, first, final = asyncio.run(go())
+        assert periodic, "periodic dump must appear"
+        assert len(first["registry"]) >= 2
+        assert len(final["registry"]) == 4, "final dump captures fleet growth"
