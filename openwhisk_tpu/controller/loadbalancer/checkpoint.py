@@ -30,10 +30,7 @@ def load_snapshot(balancer, path: str, logger=None,
     from a different cluster size must not override it (re-sharding resets
     in-flight holds, exactly as a live membership change would)."""
     if not hasattr(balancer, "restore"):
-        if logger:
-            logger.warn(None, f"balancer snapshotting requested but "
-                              f"{type(balancer).__name__} keeps no "
-                              "snapshotable state; ignoring")
+        # BalancerSnapshotter.start() warns once for this condition
         return False
     try:
         with open(path) as f:
@@ -73,6 +70,7 @@ def write_snapshot(balancer, path: str, parts: Optional[dict] = None) -> None:
     snap = balancer.snapshot(parts) if parts is not None \
         else balancer.snapshot()
     d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(prefix=".balancer-snap-", dir=d)
     try:
         with os.fdopen(fd, "w") as f:
@@ -96,6 +94,7 @@ class BalancerSnapshotter:
         self.interval = interval
         self.logger = logger
         self._scheduler: Optional[Scheduler] = None
+        self._inflight = None  # executor future of the dump being written
 
     def start(self) -> "BalancerSnapshotter":
         if hasattr(self.balancer, "snapshot"):
@@ -113,14 +112,23 @@ class BalancerSnapshotter:
         # capture on the loop (consistent device-state ref + host-book
         # copies), then do the device->host transfer + serialize + write on
         # a worker thread — at the 64k north-star fleet the dump must not
-        # stall the 2 ms batch-window data plane
+        # stall the 2 ms batch-window data plane. The executor future is
+        # retained so stop() can wait the thread out: a cancelled task does
+        # NOT stop the thread, and its late os.replace must never land on
+        # top of the final shutdown snapshot.
         parts = self.balancer.snapshot_parts()
-        await asyncio.to_thread(write_snapshot, self.balancer, self.path,
-                                parts)
+        self._inflight = asyncio.get_running_loop().run_in_executor(
+            None, write_snapshot, self.balancer, self.path, parts)
+        await self._inflight
 
     async def stop(self, final_dump: bool = True) -> None:
         if self._scheduler is not None:
             await self._scheduler.stop()
+        if self._inflight is not None and not self._inflight.done():
+            try:  # drain the orphaned dump thread before the final dump
+                await self._inflight
+            except Exception:  # noqa: BLE001 — its failure doesn't matter here
+                pass
         if final_dump and hasattr(self.balancer, "snapshot"):
             try:
                 write_snapshot(self.balancer, self.path)

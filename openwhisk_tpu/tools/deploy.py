@@ -140,6 +140,20 @@ def services(inv: dict, python: str = sys.executable,
                 "--balancer", inv["controllers"].get("balancer", "tpu")]
         if i == 0 and inv["controllers"].get("seed_guest", True):
             argv.append("--seed-guest")
+        # balancer checkpoint/resume (SURVEY §5.4): per-controller snapshot
+        # files under the configured directory; restarts skip the warm-up
+        # window instead of double-booking in-flight capacity
+        snap_dir = inv["controllers"].get("snapshot_dir")
+        if snap_dir:
+            argv += ["--balancer-snapshot",
+                     os.path.join(snap_dir, f"controller{i}.snap")]
+            interval = inv["controllers"].get("snapshot_interval")
+            if interval is not None:
+                if float(interval) <= 0:
+                    raise ValueError(
+                        f"controllers.snapshot_interval must be > 0, "
+                        f"got {interval!r}")
+                argv += ["--balancer-snapshot-interval", str(interval)]
         out.append({"name": f"controller{i}", "argv": argv})
     if inv["edge"].get("enabled", True):
         argv = [python, "-m", "openwhisk_tpu.edge",

@@ -45,6 +45,23 @@ class TestInventoryAndRenderers:
         i = ctrl[0]["argv"].index("--cluster-size")
         assert ctrl[0]["argv"][i + 1] == "2"
 
+    def test_snapshot_dir_renders_per_controller(self):
+        inv = deploy.load_inventory(None)
+        inv["controllers"].update(count=2, snapshot_dir="/var/run/owtpu",
+                                  snapshot_interval=5)
+        ctrls = [s for s in deploy.services(inv)
+                 if s["name"].startswith("controller")]
+        for i, s in enumerate(ctrls):
+            argv = s["argv"]
+            snap = argv[argv.index("--balancer-snapshot") + 1]
+            assert snap == f"/var/run/owtpu/controller{i}.snap", \
+                "each controller needs its OWN snapshot file"
+            assert argv[argv.index("--balancer-snapshot-interval") + 1] == "5"
+        # without snapshot_dir the flag is absent
+        inv2 = deploy.load_inventory(None)
+        for s in deploy.services(inv2):
+            assert "--balancer-snapshot" not in s["argv"]
+
     def test_docstore_topology(self):
         """docstore enabled: the service joins the spine and every
         controller/invoker dials docstore:// instead of opening a file."""
