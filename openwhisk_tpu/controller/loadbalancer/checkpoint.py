@@ -17,6 +17,7 @@ import asyncio
 import json
 import os
 import tempfile
+import threading
 from typing import Optional
 
 from ...utils.scheduler import Scheduler
@@ -94,7 +95,9 @@ class BalancerSnapshotter:
         self.interval = interval
         self.logger = logger
         self._scheduler: Optional[Scheduler] = None
-        self._inflight = None  # executor future of the dump being written
+        #: set when the dump thread finishes; survives task cancellation
+        #: (the asyncio wrapper future dies on cancel, the thread does not)
+        self._inflight_done: Optional[threading.Event] = None
 
     def start(self) -> "BalancerSnapshotter":
         if hasattr(self.balancer, "snapshot"):
@@ -112,23 +115,30 @@ class BalancerSnapshotter:
         # capture on the loop (consistent device-state ref + host-book
         # copies), then do the device->host transfer + serialize + write on
         # a worker thread — at the 64k north-star fleet the dump must not
-        # stall the 2 ms batch-window data plane. The executor future is
-        # retained so stop() can wait the thread out: a cancelled task does
-        # NOT stop the thread, and its late os.replace must never land on
-        # top of the final shutdown snapshot.
+        # stall the 2 ms batch-window data plane. Thread completion is
+        # tracked by a threading.Event, NOT the asyncio future: cancelling
+        # the awaiting task marks the future done while the thread keeps
+        # running, and its late os.replace must never land on top of the
+        # final shutdown snapshot.
         parts = self.balancer.snapshot_parts()
-        self._inflight = asyncio.get_running_loop().run_in_executor(
-            None, write_snapshot, self.balancer, self.path, parts)
-        await self._inflight
+        done = threading.Event()
+        self._inflight_done = done
+
+        def work():
+            try:
+                write_snapshot(self.balancer, self.path, parts)
+            finally:
+                done.set()
+
+        await asyncio.to_thread(work)
 
     async def stop(self, final_dump: bool = True) -> None:
         if self._scheduler is not None:
             await self._scheduler.stop()
-        if self._inflight is not None and not self._inflight.done():
-            try:  # drain the orphaned dump thread before the final dump
-                await self._inflight
-            except Exception:  # noqa: BLE001 — its failure doesn't matter here
-                pass
+        if self._inflight_done is not None and \
+                not self._inflight_done.is_set():
+            # drain the orphaned dump thread before the final dump
+            await asyncio.to_thread(self._inflight_done.wait, 30)
         if final_dump and hasattr(self.balancer, "snapshot"):
             try:
                 write_snapshot(self.balancer, self.path)

@@ -144,15 +144,21 @@ def services(inv: dict, python: str = sys.executable,
         # files under the configured directory; restarts skip the warm-up
         # window instead of double-booking in-flight capacity
         snap_dir = inv["controllers"].get("snapshot_dir")
+        interval = inv["controllers"].get("snapshot_interval")
+        if interval is not None:
+            if float(interval) <= 0:
+                raise ValueError(
+                    f"controllers.snapshot_interval must be > 0, "
+                    f"got {interval!r}")
+            if not snap_dir:
+                raise ValueError(
+                    "controllers.snapshot_interval is set but "
+                    "controllers.snapshot_dir is not — no snapshots would "
+                    "be written")
         if snap_dir:
             argv += ["--balancer-snapshot",
                      os.path.join(snap_dir, f"controller{i}.snap")]
-            interval = inv["controllers"].get("snapshot_interval")
             if interval is not None:
-                if float(interval) <= 0:
-                    raise ValueError(
-                        f"controllers.snapshot_interval must be > 0, "
-                        f"got {interval!r}")
                 argv += ["--balancer-snapshot-interval", str(interval)]
         out.append({"name": f"controller{i}", "argv": argv})
     if inv["edge"].get("enabled", True):
