@@ -621,7 +621,10 @@ class ControllerApi:
             binding = None
             b = body.get("binding") or {}
             if b:
-                binding = Binding(EntityPath(b["namespace"]), EntityName(b["name"]))
+                # "_" in the binding reference resolves to the caller's
+                # namespace, like everywhere else on the API surface
+                b_ns = ns if b["namespace"] == "_" else b["namespace"]
+                binding = Binding(EntityPath(b_ns), EntityName(b["name"]))
                 await self.c.entity_store.get_package(str(binding.fqn))  # must exist
             pkg = WhiskPackage(EntityPath(ns), EntityName(name), binding,
                                Parameters.from_json(body.get("parameters")),

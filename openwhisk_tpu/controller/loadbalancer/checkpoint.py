@@ -138,7 +138,17 @@ class BalancerSnapshotter:
         if self._inflight_done is not None and \
                 not self._inflight_done.is_set():
             # drain the orphaned dump thread before the final dump
-            await asyncio.to_thread(self._inflight_done.wait, 30)
+            drained = await asyncio.to_thread(self._inflight_done.wait, 30)
+            if not drained:
+                # the stuck thread could still os.replace AFTER our final
+                # dump, silently shipping stale state to the next boot —
+                # better to keep the last periodic snapshot and say so
+                if self.logger:
+                    self.logger.warn(
+                        None, "balancer dump thread still running after "
+                              "30s; skipping the final shutdown snapshot "
+                              "(last periodic dump remains)")
+                final_dump = False
         if final_dump and hasattr(self.balancer, "snapshot"):
             try:
                 write_snapshot(self.balancer, self.path)

@@ -25,22 +25,29 @@ def main() -> None:
     args = parser.parse_args()
 
     async def run():
-        store = None
-        if args.db:
-            from ..database import open_store
-            store = open_store(args.db)
-        controller = await make_standalone(port=args.port, artifact_store=store,
-                                           user_memory_mb=args.memory,
-                                           prewarm=args.prewarm,
-                                           balancer=args.balancer)
-        print(f"OpenWhisk-TPU standalone listening on :{args.port} "
-              f"(balancer={args.balancer})")
-        print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")
-        print(f"  API      http://127.0.0.1:{args.port}/api/v1")
+        from ..utils.tracing import maybe_enable_zipkin
+        zipkin = maybe_enable_zipkin("standalone")
+        controller = None
         try:
+            store = None
+            if args.db:
+                from ..database import open_store
+                store = open_store(args.db)
+            controller = await make_standalone(port=args.port,
+                                               artifact_store=store,
+                                               user_memory_mb=args.memory,
+                                               prewarm=args.prewarm,
+                                               balancer=args.balancer)
+            print(f"OpenWhisk-TPU standalone listening on :{args.port} "
+                  f"(balancer={args.balancer})")
+            print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")
+            print(f"  API      http://127.0.0.1:{args.port}/api/v1")
             await wait_for_shutdown()
         finally:
-            await controller.stop()
+            if controller is not None:
+                await controller.stop()
+            if zipkin is not None:
+                await zipkin.close()
 
     asyncio.run(run())
 

@@ -247,6 +247,28 @@ async def run(args) -> int:
             params = {"overwrite": "true"} if args.cmd == "update" else {}
             return show(*await client.request(
                 "PUT", f"/namespaces/{ns}/packages/{args.name}", body, params))
+        if args.cmd == "bind":
+            # wsk package bind PROVIDER BOUND_NAME [-p k v]: the binding
+            # inherits the provider's parameters, overridden by -p
+            # (ref Packages.scala binding semantics)
+            if not (args.name and args.artifact):
+                print("usage: wsk package bind <provider> <name> [-p k v]",
+                      file=sys.stderr)
+                return 2
+            segs = [s for s in args.name.strip("/").split("/") if s]
+            if len(segs) == 2:
+                b_ns, b_name = segs
+            elif len(segs) == 1:
+                b_ns, b_name = ns, segs[0]
+            else:
+                print(f"error: invalid provider reference {args.name!r} "
+                      "(want 'package' or '/namespace/package')",
+                      file=sys.stderr)
+                return 2
+            body = {"binding": {"namespace": b_ns, "name": b_name},
+                    "parameters": _kv_list(_params_to_dict(args.param))}
+            return show(*await client.request(
+                "PUT", f"/namespaces/{ns}/packages/{args.artifact}", body))
         if args.cmd in ("get", "delete", "list"):
             method = {"get": "GET", "delete": "DELETE", "list": "GET"}[args.cmd]
             path = f"/namespaces/{ns}/packages" + \

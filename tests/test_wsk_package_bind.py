@@ -1,0 +1,62 @@
+"""`wsk package bind` (ref wsk CLI + Packages.scala binding semantics):
+bind a provider package under a new name with parameter overrides, then
+invoke an action through the binding."""
+import asyncio
+import base64
+
+import aiohttp
+
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID, make_standalone
+from openwhisk_tpu.tools import wsk
+
+AUTH_PAIR = f"{GUEST_UUID}:{GUEST_KEY}"
+AUTH = "Basic " + base64.b64encode(AUTH_PAIR.encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+PORT = 13283
+HOST = f"http://127.0.0.1:{PORT}"
+BASE = f"{HOST}/api/v1"
+
+CODE = "def main(a):\n    return {'who': a.get('who')}\n"
+
+
+async def _wsk(*argv) -> int:
+    return await asyncio.to_thread(
+        wsk.main, ["--apihost", HOST, "--auth", AUTH_PAIR, *argv])
+
+
+def test_bind_and_invoke_through_binding():
+    async def go():
+        controller = await make_standalone(port=PORT)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{BASE}/namespaces/_/packages/provider",
+                                 headers=HDRS,
+                                 json={"parameters": [
+                                     {"key": "who", "value": "provider"}]}) as r:
+                    assert r.status == 200
+                async with s.put(
+                        f"{BASE}/namespaces/_/actions/provider/who",
+                        headers=HDRS,
+                        json={"exec": {"kind": "python:3",
+                                       "code": CODE}}) as r:
+                    assert r.status == 200
+                # relative provider reference resolves to the caller's ns
+                assert await _wsk("package", "bind", "provider", "mybind",
+                                  "-p", "who", "bound") == 0
+                async with s.get(f"{BASE}/namespaces/_/packages/mybind",
+                                 headers=HDRS) as r:
+                    doc = await r.json()
+                    assert doc["binding"]["name"] == "provider"
+                    assert doc["binding"]["namespace"] == "guest"
+                async with s.post(
+                        f"{BASE}/namespaces/_/actions/mybind/who"
+                        "?blocking=true&result=true",
+                        headers=HDRS, json={}) as r:
+                    assert r.status == 200
+                    assert await r.json() == {"who": "bound"}
+                # binding to a nonexistent provider fails loudly
+                assert await _wsk("package", "bind", "ghost", "b2") == 1
+        finally:
+            await controller.stop()
+
+    asyncio.run(go())
