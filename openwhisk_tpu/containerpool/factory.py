@@ -29,6 +29,18 @@ class ContainerPoolConfig:
         return max(2, int(total_share * memory.to_mb / max(1, self.user_memory.to_mb)))
 
 
+#: shorthand name -> ContainerFactoryProvider SPI path; the single source
+#: of truth for the invoker's --container-factory choices and the deploy
+#: inventory's invokers.container_factory validation
+FACTORY_PROVIDERS = {
+    "process": "openwhisk_tpu.containerpool.process_factory:ProcessContainerFactoryProvider",
+    "docker": "openwhisk_tpu.containerpool.docker_factory:DockerContainerFactoryProvider",
+    "kubernetes": "openwhisk_tpu.containerpool.kubernetes_factory:KubernetesContainerFactoryProvider",
+    "yarn": "openwhisk_tpu.containerpool.yarn_factory:YARNContainerFactoryProvider",
+    "mesos": "openwhisk_tpu.containerpool.mesos_factory:MesosContainerFactoryProvider",
+}
+
+
 class ContainerFactory:
     """SPI: async container creation + janitorial cleanup."""
 

@@ -625,7 +625,21 @@ class ControllerApi:
                 # namespace, like everywhere else on the API surface
                 b_ns = ns if b["namespace"] == "_" else b["namespace"]
                 binding = Binding(EntityPath(b_ns), EntityName(b["name"]))
-                await self.c.entity_store.get_package(str(binding.fqn))  # must exist
+                provider = await self.c.entity_store.get_package(
+                    str(binding.fqn))  # must exist
+                # ref Packages.scala bind semantics: no chains (a provider
+                # that is itself a binding dereferences only one level, so
+                # its "actions" don't exist), and a cross-namespace bind
+                # requires the provider be published — otherwise any
+                # authenticated user could lift a private package's
+                # parameters (credentials) into their own namespace
+                if provider.binding is not None:
+                    return _error(400, "cannot bind to another binding",
+                                  request["transid"])
+                if b_ns != ns and not provider.publish:
+                    return _error(
+                        403, "the referenced package is not public",
+                        request["transid"])
             pkg = WhiskPackage(EntityPath(ns), EntityName(name), binding,
                                Parameters.from_json(body.get("parameters")),
                                publish=bool(body.get("publish", False)),

@@ -13,6 +13,7 @@ import argparse
 import asyncio
 
 from ..containerpool import ContainerPoolConfig
+from ..containerpool.factory import FACTORY_PROVIDERS
 from ..core.entity import ExecManifest, InvokerInstanceId, MB
 from ..database import ArtifactActivationStore, EntityStore, open_store
 from ..messaging.tcp import TcpMessagingProvider
@@ -21,15 +22,6 @@ from .id_assigner import InstanceIdAssigner
 from .reactive import InvokerReactive
 from .server import InvokerServer
 from ..utils.tasks import wait_for_shutdown
-
-#: --container-factory shorthand -> SPI implementation path
-_FACTORY_SHORTHAND = {
-    "process": "openwhisk_tpu.containerpool.process_factory:ProcessContainerFactoryProvider",
-    "docker": "openwhisk_tpu.containerpool.docker_factory:DockerContainerFactoryProvider",
-    "kubernetes": "openwhisk_tpu.containerpool.kubernetes_factory:KubernetesContainerFactoryProvider",
-    "yarn": "openwhisk_tpu.containerpool.yarn_factory:YARNContainerFactoryProvider",
-    "mesos": "openwhisk_tpu.containerpool.mesos_factory:MesosContainerFactoryProvider",
-}
 
 
 def main() -> None:
@@ -45,7 +37,7 @@ def main() -> None:
     parser.add_argument("--prewarm", action="store_true")
     parser.add_argument(
         "--container-factory", default=None,
-        choices=("process", "docker", "kubernetes", "yarn", "mesos"),
+        choices=tuple(FACTORY_PROVIDERS),
         help="container driver shorthand; without it the "
              "ContainerFactoryProvider SPI resolves (default: process; "
              "override via CONFIG_whisk_spi_ContainerFactoryProvider)")
@@ -70,7 +62,7 @@ def main() -> None:
             # ContainerFactoryProvider); the CLI shorthand binds it
             from .. import spi
             if args.container_factory:
-                spi.bind("ContainerFactoryProvider", _FACTORY_SHORTHAND[
+                spi.bind("ContainerFactoryProvider", FACTORY_PROVIDERS[
                     args.container_factory])
             factory = spi.get("ContainerFactoryProvider").instance(
                 invoker_name=args.unique_name, logger=logger)

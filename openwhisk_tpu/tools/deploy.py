@@ -127,6 +127,14 @@ def services(inv: dict, python: str = sys.executable,
                 "--memory", str(inv["invokers"]["memory_mb"])]
         if inv["invokers"].get("prewarm"):
             argv.append("--prewarm")
+        factory = inv["invokers"].get("container_factory")
+        if factory:
+            from ..containerpool.factory import FACTORY_PROVIDERS
+            if factory not in FACTORY_PROVIDERS:
+                raise ValueError(
+                    f"invokers.container_factory must be one of "
+                    f"{'/'.join(FACTORY_PROVIDERS)}, got {factory!r}")
+            argv += ["--container-factory", factory]
         out.append({"name": f"invoker{i}", "argv": argv})
     n_ctrl = inv["controllers"]["count"]
     ctrl_urls = []

@@ -62,6 +62,18 @@ class TestInventoryAndRenderers:
         for s in deploy.services(inv2):
             assert "--balancer-snapshot" not in s["argv"]
 
+    def test_container_factory_renders_and_validates(self):
+        import pytest
+        inv = deploy.load_inventory(None)
+        inv["invokers"]["container_factory"] = "docker"
+        invoker = [s for s in deploy.services(inv)
+                   if s["name"] == "invoker0"][0]
+        i = invoker["argv"].index("--container-factory")
+        assert invoker["argv"][i + 1] == "docker"
+        inv["invokers"]["container_factory"] = "podman"
+        with pytest.raises(ValueError, match="container_factory"):
+            deploy.services(inv)
+
     def test_docstore_topology(self):
         """docstore enabled: the service joins the spine and every
         controller/invoker dials docstore:// instead of opening a file."""

@@ -56,6 +56,57 @@ def test_bind_and_invoke_through_binding():
                     assert await r.json() == {"who": "bound"}
                 # binding to a nonexistent provider fails loudly
                 assert await _wsk("package", "bind", "ghost", "b2") == 1
+                # malformed provider references: usage error, no traceback
+                assert await _wsk("package", "bind", "a/b/c", "b3") == 2
+                # binding to a binding is rejected (one-level dereference)
+                async with s.put(f"{BASE}/namespaces/_/packages/chain",
+                                 headers=HDRS,
+                                 json={"binding": {"namespace": "guest",
+                                                   "name": "mybind"}}) as r:
+                    assert r.status == 400
+                    assert "binding" in (await r.json())["error"]
+        finally:
+            await controller.stop()
+
+    asyncio.run(go())
+
+
+def test_cross_namespace_bind_requires_public_provider():
+    """Security: a private package in another namespace must not be
+    bindable (its parameters often carry credentials); publishing it opens
+    the bind (ref Packages.scala bind semantics)."""
+    async def go():
+        controller = await make_standalone(port=PORT + 1)
+        base = f"http://127.0.0.1:{PORT + 1}/api/v1"
+        try:
+            # a second identity with its own namespace owning a package
+            from openwhisk_tpu.core.entity import (Identity, WhiskAuthRecord,
+                                                   WhiskPackage, EntityPath,
+                                                   EntityName, Parameters)
+            victim = Identity.generate("victim")
+            await controller.auth_store.put(WhiskAuthRecord(
+                victim.subject, [victim.namespace], [victim.authkey]))
+            secret = WhiskPackage(EntityPath("victim"), EntityName("creds"),
+                                  None, Parameters.from_json(
+                                      [{"key": "apikey", "value": "s3cr3t"}]))
+            await controller.entity_store.put(secret)
+
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{base}/namespaces/_/packages/steal",
+                                 headers=HDRS,
+                                 json={"binding": {"namespace": "victim",
+                                                   "name": "creds"}}) as r:
+                    assert r.status == 403, await r.text()
+                # the victim publishes: the bind opens
+                secret2 = await controller.entity_store.get_package(
+                    "victim/creds")
+                secret2.publish = True
+                await controller.entity_store.put(secret2)
+                async with s.put(f"{base}/namespaces/_/packages/ok",
+                                 headers=HDRS,
+                                 json={"binding": {"namespace": "victim",
+                                                   "name": "creds"}}) as r:
+                    assert r.status == 200, await r.text()
         finally:
             await controller.stop()
 
