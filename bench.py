@@ -287,7 +287,7 @@ def _cpu_oracle_rate(n: int = N_INVOKERS, reqs: int = 2048) -> float:
                for a in range(64)]
     t0 = time.perf_counter()
     placed = []
-    for i in range(reqs):
+    for _ in range(reqs):
         ns, act, mem = actions[rng.randint(0, 64)]
         c, _ = schedule(st, ns, act, mem)
         placed.append((c, act, mem))

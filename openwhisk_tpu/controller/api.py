@@ -15,25 +15,22 @@ controller/cors.py); web actions manage their own CORS + OPTIONS preflight.
 """
 from __future__ import annotations
 
-import asyncio
 import json
-import time
 from typing import Optional
 
 from aiohttp import web
 
-from ..core.entity import (ACTIVE, ActivationId, Binding, ConcurrencyLimit,
-                           EntityName, EntityPath, Exec, ExecManifest,
-                           Identity, LimitViolation, LogLimit, MB, MemoryLimit,
-                           Parameters, ReducedRule, SemVer, SequenceExec,
-                           TimeLimit, WhiskAction, WhiskActivation, WhiskPackage,
-                           WhiskRule, WhiskTrigger)
+from ..core.entity import (ACTIVE, ActivationId, Binding, EntityName,
+                           EntityPath, Exec, ExecManifest, Identity,
+                           LimitViolation, MemoryLimit, Parameters,
+                           ReducedRule, SequenceExec, TimeLimit, WhiskAction,
+                           WhiskActivation, WhiskPackage, WhiskRule,
+                           WhiskTrigger)
 from ..core.entity.action import ActionLimits
 from ..core.entity.names import FullyQualifiedEntityName
 from ..database import DocumentConflict, NoDocumentException
 from ..utils.transaction import TransactionId
-from .entitlement import (ACTIVATE, DELETE, EntitlementException, PUT, READ,
-                          ThrottleRejectRequest)
+from .entitlement import ACTIVATE, DELETE, EntitlementException, PUT, READ
 from .loadbalancer.base import LoadBalancerException
 from .invoke import resolve_action
 from .routemgmt import ApiManagementException
@@ -625,21 +622,31 @@ class ControllerApi:
                 # namespace, like everywhere else on the API surface
                 b_ns = ns if b["namespace"] == "_" else b["namespace"]
                 binding = Binding(EntityPath(b_ns), EntityName(b["name"]))
-                provider = await self.c.entity_store.get_package(
-                    str(binding.fqn))  # must exist
-                # ref Packages.scala bind semantics: no chains (a provider
-                # that is itself a binding dereferences only one level, so
-                # its "actions" don't exist), and a cross-namespace bind
-                # requires the provider be published — otherwise any
-                # authenticated user could lift a private package's
-                # parameters (credentials) into their own namespace
-                if provider.binding is not None:
-                    return _error(400, "cannot bind to another binding",
-                                  request["transid"])
+                # a cross-namespace bind requires the provider be published
+                # — otherwise any authenticated user could lift a private
+                # package's parameters (credentials) into their own
+                # namespace. Nonexistent and private providers answer
+                # IDENTICALLY so the bind surface cannot be used as an
+                # existence oracle for other namespaces' package names.
+                try:
+                    provider = await self.c.entity_store.get_package(
+                        str(binding.fqn))  # must exist
+                except NoDocumentException:
+                    if b_ns != ns:
+                        return _error(
+                            403, "the referenced package is not accessible",
+                            request["transid"])
+                    raise
                 if b_ns != ns and not provider.publish:
                     return _error(
-                        403, "the referenced package is not public",
+                        403, "the referenced package is not accessible",
                         request["transid"])
+                # ref Packages.scala bind semantics: no chains — a provider
+                # that is itself a binding dereferences only one level, so
+                # its "actions" could never resolve
+                if provider.is_binding:
+                    return _error(400, "cannot bind to another binding",
+                                  request["transid"])
             pkg = WhiskPackage(EntityPath(ns), EntityName(name), binding,
                                Parameters.from_json(body.get("parameters")),
                                publish=bool(body.get("publish", False)),

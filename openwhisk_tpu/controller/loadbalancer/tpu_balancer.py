@@ -472,7 +472,7 @@ class TpuBalancer(CommonLoadBalancer):
         the whole checkpoint story: dump it periodically, restore on boot to
         skip the warm-up window. Thread-safe given `parts` from
         snapshot_parts()."""
-        parts = parts if parts is not None else self.snapshot_parts()
+        parts = dict(parts) if parts is not None else self.snapshot_parts()
         state = parts.pop("state")
         conc = np.asarray(state.conc_free)
         nz = np.nonzero(conc)

@@ -96,7 +96,16 @@ def test_cross_namespace_bind_requires_public_provider():
                                  headers=HDRS,
                                  json={"binding": {"namespace": "victim",
                                                    "name": "creds"}}) as r:
-                    assert r.status == 403, await r.text()
+                    private = (r.status, (await r.json())["error"])
+                # no existence oracle: a nonexistent cross-ns provider must
+                # be INDISTINGUISHABLE from a private one
+                async with s.put(f"{base}/namespaces/_/packages/probe",
+                                 headers=HDRS,
+                                 json={"binding": {"namespace": "victim",
+                                                   "name": "nope"}}) as r:
+                    ghost = (r.status, (await r.json())["error"])
+                assert private == ghost == \
+                    (403, "the referenced package is not accessible")
                 # the victim publishes: the bind opens
                 secret2 = await controller.entity_store.get_package(
                     "victim/creds")
