@@ -235,7 +235,16 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
             inst = InvokerInstanceId(i, user_memory=MB(8192))
             feeds.append(await echo_invoker(provider, inst))
             await producer.send("health", PingMessage(inst))
-        await asyncio.sleep(0.3)
+        # wait until supervision has actually registered the fleet (a fixed
+        # sleep races the first device-program compile on slow channels)
+        from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= n_invokers:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("balancer bench: fleet never became healthy")
 
         actions = [make_action(f"bench{i}", memory=128) for i in range(8)]
         ident = Identity.generate("guest")
