@@ -38,12 +38,15 @@ def guest_identity() -> Identity:
 async def make_standalone(port: int = 3233, artifact_store=None,
                           user_memory_mb: int = 2048, logger=None,
                           prewarm: bool = False, manifest: Optional[dict] = None,
-                          balancer: str = "lean") -> Controller:
+                          balancer: str = "lean",
+                          **controller_kw) -> Controller:
     """Assemble and start a standalone server; returns the running Controller.
 
     balancer: "lean" (in-process dispatch, no supervision — the reference's
     LeanBalancer mode) or "tpu" (the device placement kernel fed by the
-    in-process invoker's real health pings)."""
+    in-process invoker's real health pings). Extra keyword arguments pass
+    through to Controller (e.g. invocations_per_minute for perf runs that
+    must not trip the default throttles)."""
     logger = logger or Logging(level="warn")
     ExecManifest.initialize(manifest)
     provider = MemoryMessagingProvider()
@@ -71,7 +74,7 @@ async def make_standalone(port: int = 3233, artifact_store=None,
         lb = LeanBalancer(provider, instance, invoker_factory, logger=logger,
                           user_memory=MB(user_memory_mb))
     controller = Controller(instance, provider, artifact_store=artifact_store,
-                            logger=logger, load_balancer=lb)
+                            logger=logger, load_balancer=lb, **controller_kw)
     # seed the guest identity
     ident = guest_identity()
     await controller.auth_store.put(

@@ -170,9 +170,18 @@ async def timed_loop(n_requests: int, concurrency: int,
 
 
 def run_with_standalone(coro_fn, port: int = 13366, **standalone_kw):
-    """Boot the standalone server, run coro_fn(client), tear down."""
+    """Boot the standalone server, run coro_fn(client), tear down.
+
+    Throttles are raised far past what any simulation drives (the reference
+    perf setups do the same in their deployment config,
+    tests/performance/README.md) — the harness measures the data plane, not
+    the 60/min namespace rate limit; ThrottleTests cover enforcement."""
     from openwhisk_tpu.standalone import (GUEST_KEY, GUEST_UUID,
                                           make_standalone)
+
+    standalone_kw.setdefault("invocations_per_minute", 1_000_000)
+    standalone_kw.setdefault("concurrent_invocations", 10_000)
+    standalone_kw.setdefault("fires_per_minute", 1_000_000)
 
     async def go():
         controller = await make_standalone(port=port, **standalone_kw)
