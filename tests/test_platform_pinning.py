@@ -1,0 +1,29 @@
+"""The JAX_PLATFORMS contract for spawned services (the round-3 flagship
+hermeticity failure): some PJRT plugins register regardless of the env var,
+so services apply it through the config API at boot
+(utils.config.honor_jax_platforms_env). If this regresses, every
+multi-controller chaos/deploy test starts contending for the one tunneled
+TPU chip again."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_env_var_is_honored_through_config_api():
+    """A fresh process with JAX_PLATFORMS=cpu must resolve the CPU backend
+    after the boot hook — never an accelerator. (No unpinned variant: a
+    subprocess without the pin would initialize and grab the one tunneled
+    chip, recreating the exact contention this contract prevents.)"""
+    code = (
+        "from openwhisk_tpu.utils.config import honor_jax_platforms_env\n"
+        "honor_jax_platforms_env()\n"
+        "import jax\n"
+        "print(jax.default_backend())\n")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert out.stdout.strip().splitlines()[-1] == "cpu", \
+        "a service with JAX_PLATFORMS=cpu must never touch an accelerator"
