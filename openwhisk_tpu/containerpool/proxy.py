@@ -154,7 +154,9 @@ class ContainerProxy:
         self.state = RUNNING
         init_ms = 0
         try:
-            init_payload = action.container_initializer(env=self._auth_env(msg))
+            init_payload = action.container_initializer(
+                env={"__OW_" + k.upper(): str(v)
+                     for k, v in self._ow_env(action, msg).items()})
             init_ms = await self.container.initialize(
                 init_payload, timeout=action.limits.timeout.seconds)
         except InitializationError as e:
@@ -196,13 +198,7 @@ class ContainerProxy:
                        init_ms: int) -> None:
         params = action.parameters.merge(
             Parameters.from_arguments(msg.content or {}))
-        env = {
-            "namespace": str(msg.user.namespace.name),
-            "action_name": str(action.fully_qualified_name),
-            "activation_id": msg.activation_id.asString,
-            "transaction_id": msg.transid.id,
-            "deadline": str(int((time.time() + action.limits.timeout.seconds) * 1000)),
-        }
+        env = self._ow_env(action, msg)
         result: RunResult = await self.container.run(
             params.to_arguments(), env, timeout=action.limits.timeout.seconds)
         response = _response_from_run(result)
@@ -330,8 +326,33 @@ class ContainerProxy:
             return e.kind
         return m.image.resolved
 
-    def _auth_env(self, msg: ActivationMessage) -> Dict[str, Any]:
-        return {"__OW_API_KEY": msg.user.authkey.compact}
+    def _ow_env(self, action: ExecutableWhiskAction,
+                msg: ActivationMessage) -> Dict[str, Any]:
+        """The activation context handed to the container, identical for /init
+        (``__OW_``-uppercased by the caller) and /run (bare keys; the runtime
+        prefixes) — ref ContainerProxy.scala:680-701 authEnvironment ++
+        environment ++ deadline."""
+        return {
+            **self._auth_env(action, msg),
+            "namespace": str(msg.user.namespace.name),
+            "action_name": str(action.fully_qualified_name),
+            "action_version": str(action.version),
+            "activation_id": msg.activation_id.asString,
+            "transaction_id": msg.transid.id,
+            "deadline": str(int((time.time() + action.limits.timeout.seconds) * 1000)),
+        }
+
+    def _auth_env(self, action: ExecutableWhiskAction,
+                  msg: ActivationMessage) -> Dict[str, Any]:
+        """The API key for the action context, withheld when the action's
+        `provide-api-key` annotation is present and not truthy; a missing
+        annotation provides the key for backward compatibility
+        (ref ContainerProxy.scala:688-693, Annotations.scala:26)."""
+        from ..core.feature_flags import PROVIDE_API_KEY_ANNOTATION
+        if not action.annotations.is_truthy(PROVIDE_API_KEY_ANNOTATION,
+                                            value_for_non_existent=True):
+            return {}
+        return {"api_key": msg.user.authkey.compact}
 
     def _log_warn(self, text: str) -> None:
         if self.logger:

@@ -45,6 +45,26 @@ def _error(status: int, message: str, transid: Optional[TransactionId] = None
                              status=status)
 
 
+def _amend_annotations(annotations: Parameters, exec_: Exec,
+                       create: bool) -> Parameters:
+    """System annotations stamped on action create/update
+    (ref Actions.scala:55-84 amendAnnotations): on *create* with the
+    requireApiKeyAnnotation feature flag on, `provide-api-key: false` is added
+    unless the client already declared it (existing actions are never
+    retrofitted — it would break them); the `exec` kind annotation is always
+    added and overrides any client-supplied value, so list views can show kinds
+    without fetching each action."""
+    from ..core.feature_flags import (EXEC_ANNOTATION,
+                                      PROVIDE_API_KEY_ANNOTATION,
+                                      feature_flags)
+    from ..core.entity.parameters import ParameterValue
+    if create and feature_flags().require_api_key_annotation \
+            and PROVIDE_API_KEY_ANNOTATION not in annotations:
+        annotations = annotations + Parameters(
+            {PROVIDE_API_KEY_ANNOTATION: ParameterValue(False)})
+    return annotations + Parameters({EXEC_ANNOTATION: ParameterValue(exec_.kind)})
+
+
 class ControllerApi:
     def __init__(self, controller):
         """`controller` is openwhisk_tpu.controller.core.Controller."""
@@ -322,21 +342,32 @@ class ControllerApi:
             body = await request.json()
         except json.JSONDecodeError:
             return _error(400, "malformed JSON body", request["transid"])
-        if "exec" not in body:
+        try:
+            old = await self.c.entity_store.get_action(str(fqn))
+        except NoDocumentException:
+            old = None
+        if old is not None and not overwrite:
+            return _error(409, "resource already exists", request["transid"])
+        if "exec" in body:
+            exec_ = Exec.from_json(body["exec"])
+            if exec_.kind not in ("sequence", "blackbox"):
+                resolved = ExecManifest.runtimes().resolve_default(exec_.kind)
+                if not ExecManifest.runtimes().knows(resolved):
+                    return _error(
+                        400, f"kind '{exec_.kind}' not in Set({', '.join(ExecManifest.runtimes().kinds)})",
+                        request["transid"])
+                exec_.kind = resolved
+                self.c.entitlement.check_kind(request["identity"], exec_.kind)
+            if isinstance(exec_, SequenceExec):
+                exec_.components = [c.resolve(ns) for c in exec_.components]
+                if len(exec_.components) > self.c.action_sequence_limit:
+                    raise LimitViolation("too many actions in the sequence")
+        elif old is not None:
+            # exec, like every other field, is optional on update
+            # (ref WhiskActionPut: `content.exec getOrElse action.exec`)
+            exec_ = old.exec
+        else:
             return _error(400, "exec undefined", request["transid"])
-        exec_ = Exec.from_json(body["exec"])
-        if exec_.kind not in ("sequence", "blackbox"):
-            resolved = ExecManifest.runtimes().resolve_default(exec_.kind)
-            if not ExecManifest.runtimes().knows(resolved):
-                return _error(
-                    400, f"kind '{exec_.kind}' not in Set({', '.join(ExecManifest.runtimes().kinds)})",
-                    request["transid"])
-            exec_.kind = resolved
-            self.c.entitlement.check_kind(request["identity"], exec_.kind)
-        if isinstance(exec_, SequenceExec):
-            exec_.components = [c.resolve(ns) for c in exec_.components]
-            if len(exec_.components) > self.c.action_sequence_limit:
-                raise LimitViolation("too many actions in the sequence")
         action = WhiskAction(
             fqn.path if not fqn.path.default_package else EntityPath(ns),
             fqn.name if isinstance(fqn.name, EntityName) else EntityName(str(fqn.name)),
@@ -348,14 +379,27 @@ class ControllerApi:
         )
         # correct namespace for packaged actions: ns/pkg
         action.namespace = fqn.path
-        try:
-            old = await self.c.entity_store.get_action(str(fqn))
-            if not overwrite:
-                return _error(409, "resource already exists", request["transid"])
+        if old is not None:
             action.version = old.version.up_patch()
             action.rev = old.rev
-        except NoDocumentException:
-            pass
+            # an update inherits every field the request omits (ref
+            # Actions.scala WhiskActionPut `getOrElse old`) — else a routine
+            # exec-only PUT would drop the stamped provide-api-key:false
+            # (re-exposing the key), reset limits to defaults (killing a
+            # long-timeout action at 60s), and unpublish
+            if "annotations" not in body:
+                action.annotations = old.annotations
+            if "parameters" not in body:
+                action.parameters = old.parameters
+            if "limits" not in body:
+                action.limits = old.limits
+            if "publish" not in body:
+                action.publish = old.publish
+            action.annotations = _amend_annotations(
+                action.annotations, exec_, create=False)
+        else:
+            action.annotations = _amend_annotations(
+                action.annotations, exec_, create=True)
         await self.c.entity_store.put(action)
         return web.json_response(action.to_json())
 

@@ -63,6 +63,23 @@ class Parameters:
         v = self.get(key)
         return v if isinstance(v, bool) else None
 
+    def is_truthy(self, key: str, value_for_non_existent: bool = False) -> bool:
+        """JSON truthiness (ref Parameter.scala:119-127 isTruthy): booleans
+        as-is, numbers != 0, strings nonempty, null false, other values true;
+        a missing key yields `value_for_non_existent`."""
+        if key not in self._params:
+            return value_for_non_existent
+        v = self.get(key)
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, (int, float)):
+            return v != 0
+        if isinstance(v, str):
+            return v != ""
+        if v is None:
+            return False
+        return True
+
     def __contains__(self, key):
         return key in self._params
 

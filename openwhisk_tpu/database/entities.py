@@ -24,6 +24,21 @@ _TYPES = {
 }
 
 
+def _rev_older_than(cached: Optional[str], routed: str) -> bool:
+    """True when `cached` is an older document revision than `routed`.
+    Revisions are couch-style "gen-digest" strings across all stores
+    (sqlite_store.py:92, memory/couchdb alike); compare the generation.
+    Unparsable revisions fall back to plain inequality — conservative: every
+    mismatching message reloads, so a store with opaque revs trades the cache
+    for correctness."""
+    if cached == routed:
+        return False
+    try:
+        return int((cached or "0").split("-", 1)[0]) < int(routed.split("-", 1)[0])
+    except (ValueError, AttributeError):
+        return True
+
+
 class EntityStore:
     # action code above this inlining threshold is stored as an attachment
     # (ref WhiskAction CodeExecAsAttachment + AttachmentStore SPI)
@@ -72,7 +87,16 @@ class EntityStore:
         await self._notify(entity.docid)
         return entity.rev
 
-    async def get(self, cls: Type, doc_id: str, use_cache: bool = True):
+    async def get(self, cls: Type, doc_id: str, use_cache: bool = True,
+                  rev: Optional[str] = None):
+        """Typed read-through get. When `rev` is given and the cached entity's
+        revision generation is OLDER than the routed one, the entry is
+        reloaded (ref InvokerReactive.scala:244-258 / WhiskStore get-by-rev:
+        the invoker must never execute an older revision than the controller
+        routed; stores serve latest, which is never older than the routed
+        rev). A cached entry at the SAME or a newer generation is served as-is
+        — a backlog of old-rev activations draining after an update must not
+        thrash the cache with one store read per message."""
         async def materialize(doc):
             exec_json = doc.get("exec")
             if isinstance(exec_json, dict) and isinstance(exec_json.get("code"), dict):
@@ -94,11 +118,16 @@ class EntityStore:
                 return await materialize(doc)
 
         if use_cache:
-            return await self.cache.get_or_load(doc_id, load)
+            ent = await self.cache.get_or_load(doc_id, load)
+            if rev and _rev_older_than(ent.rev.rev, rev):
+                self.cache.invalidate(doc_id)
+                ent = await self.cache.get_or_load(doc_id, load)
+            return ent
         return await load()
 
-    async def get_action(self, doc_id: str) -> WhiskAction:
-        return await self.get(WhiskAction, doc_id)
+    async def get_action(self, doc_id: str, rev: Optional[str] = None
+                         ) -> WhiskAction:
+        return await self.get(WhiskAction, doc_id, rev=rev)
 
     async def get_trigger(self, doc_id: str) -> WhiskTrigger:
         return await self.get(WhiskTrigger, doc_id)

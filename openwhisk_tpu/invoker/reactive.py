@@ -150,7 +150,8 @@ class InvokerReactive:
             release()
             return
         try:
-            action = await self.entity_store.get_action(str(msg.action))
+            action = await self.entity_store.get_action(str(msg.action),
+                                                        rev=msg.revision)
             executable = action.to_executable()
             if executable is None:
                 raise NoDocumentException("sequences are not executable on invokers")

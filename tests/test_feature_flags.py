@@ -1,0 +1,305 @@
+"""Feature flags + provide-api-key annotation gating.
+
+Mirrors the reference behavior in Actions.scala:55-84 (amendAnnotations:
+`provide-api-key: false` stamped on create iff the requireApiKeyAnnotation
+feature flag is on; `exec` kind annotation always added) and
+ContainerProxy.scala:688-693 (API key withheld from the action container
+unless the annotation is truthy, missing treated as truthy)."""
+import asyncio
+import time
+
+import pytest
+
+from openwhisk_tpu.containerpool import Container, ContainerProxy
+from openwhisk_tpu.containerpool.logstore import ContainerLogStore
+from openwhisk_tpu.controller.api import _amend_annotations
+from openwhisk_tpu.core.entity import (ActionLimits, ActivationId, CodeExec,
+                                       ConcurrencyLimit, ControllerInstanceId,
+                                       EntityName, EntityPath,
+                                       ExecutableWhiskAction, Identity, MB,
+                                       MemoryLimit, Parameters, TimeLimit)
+from openwhisk_tpu.core.entity.ids import DocRevision
+from openwhisk_tpu.core.entity.parameters import ParameterValue
+from openwhisk_tpu.core.feature_flags import (EXEC_ANNOTATION,
+                                              PROVIDE_API_KEY_ANNOTATION,
+                                              feature_flags)
+from openwhisk_tpu.messaging.message import ActivationMessage
+from openwhisk_tpu.utils.transaction import TransactionId
+
+FLAG_ENV = "CONFIG_whisk_featureFlags_requireApiKeyAnnotation"
+
+
+# ---------------------------------------------------------------------------
+# flag loading + annotation amendment
+# ---------------------------------------------------------------------------
+
+class TestFeatureFlagConfig:
+    def test_default_off(self, monkeypatch):
+        monkeypatch.delenv(FLAG_ENV, raising=False)
+        assert feature_flags().require_api_key_annotation is False
+
+    def test_env_channel(self, monkeypatch):
+        monkeypatch.setenv(FLAG_ENV, "true")
+        assert feature_flags().require_api_key_annotation is True
+
+
+class TestAmendAnnotations:
+    def _exec(self):
+        return CodeExec(kind="python:3", code="def main(a): return a")
+
+    def test_create_with_flag_stamps_false(self, monkeypatch):
+        monkeypatch.setenv(FLAG_ENV, "true")
+        out = _amend_annotations(Parameters(), self._exec(), create=True)
+        assert out.get(PROVIDE_API_KEY_ANNOTATION) is False
+        assert out.get(EXEC_ANNOTATION) == "python:3"
+
+    def test_create_without_flag_leaves_absent(self, monkeypatch):
+        monkeypatch.delenv(FLAG_ENV, raising=False)
+        out = _amend_annotations(Parameters(), self._exec(), create=True)
+        assert PROVIDE_API_KEY_ANNOTATION not in out
+        assert out.get(EXEC_ANNOTATION) == "python:3"
+
+    def test_client_value_preserved(self, monkeypatch):
+        monkeypatch.setenv(FLAG_ENV, "true")
+        given = Parameters({PROVIDE_API_KEY_ANNOTATION: ParameterValue(True)})
+        out = _amend_annotations(given, self._exec(), create=True)
+        assert out.get(PROVIDE_API_KEY_ANNOTATION) is True
+
+    def test_update_never_stamps(self, monkeypatch):
+        monkeypatch.setenv(FLAG_ENV, "true")
+        out = _amend_annotations(Parameters(), self._exec(), create=False)
+        assert PROVIDE_API_KEY_ANNOTATION not in out
+
+    def test_exec_annotation_overrides_client(self, monkeypatch):
+        monkeypatch.delenv(FLAG_ENV, raising=False)
+        given = Parameters({EXEC_ANNOTATION: ParameterValue("spoofed")})
+        out = _amend_annotations(given, self._exec(), create=False)
+        assert out.get(EXEC_ANNOTATION) == "python:3"
+
+
+# ---------------------------------------------------------------------------
+# proxy-side API-key gating (stub container records /init + /run env)
+# ---------------------------------------------------------------------------
+
+class EnvRecordingContainer(Container):
+    def __init__(self):
+        super().__init__("env-stub", ("127.0.0.1", 0))
+        self.init_env = None
+        self.run_env = None
+
+    async def initialize(self, init_payload, timeout=60.0):
+        self.init_env = init_payload.get("env") or {}
+        return 1
+
+    async def run(self, args, environment, timeout=60.0):
+        from openwhisk_tpu.containerpool.container import RunResult
+        self.run_env = dict(environment)
+        t = time.time()
+        return RunResult(t, time.time(), {"ok": True}, ok=True)
+
+    async def suspend(self):
+        pass
+
+    async def resume(self):
+        pass
+
+    async def logs(self, limit_bytes=10 * 1024 * 1024, wait_for_sentinel=True):
+        return []
+
+
+class EnvFactory:
+    def __init__(self):
+        self.created = []
+
+    async def create_container(self, transid, name, image, memory, cpu_shares=0,
+                               action=None):
+        c = EnvRecordingContainer()
+        self.created.append(c)
+        return c
+
+
+def _action(annotations=None):
+    limits = ActionLimits(TimeLimit(10_000), MemoryLimit(MB(256)), None,
+                          ConcurrencyLimit(1))
+    a = ExecutableWhiskAction(EntityPath("guest"), EntityName("envtest"),
+                              CodeExec(kind="python:3", code="def main(a): return a"),
+                              limits=limits, annotations=annotations or Parameters())
+    a.rev = DocRevision("1-test")
+    return a
+
+
+async def _drive(action):
+    factory = EnvFactory()
+    done = asyncio.Event()
+
+    async def ack(transid, activation, blocking, controller, user, kind):
+        if kind in ("completion", "combined"):
+            done.set()
+
+    async def store(transid, activation, user):
+        pass
+
+    from openwhisk_tpu.containerpool import ContainerPoolConfig
+    logstore = ContainerLogStore()
+    proxy = ContainerProxy(factory, ack, store, logstore.collect_logs,
+                           instance=0,
+                           pool_config=ContainerPoolConfig(
+                               pause_grace=10, idle_container_timeout=60))
+    ident = Identity.generate("guest")
+    msg = ActivationMessage(
+        TransactionId(), action.fully_qualified_name, action.rev.rev, ident,
+        ActivationId.generate(), ControllerInstanceId("0"), True, {})
+    await proxy.run(action, msg)
+    await asyncio.wait_for(done.wait(), 5)
+    return factory.created[0], ident
+
+
+class TestApiKeyGating:
+    def test_default_provides_key(self):
+        async def go():
+            c, ident = await _drive(_action())
+            assert c.init_env.get("__OW_API_KEY") == ident.authkey.compact
+            assert c.init_env.get("__OW_NAMESPACE") == "guest"
+            assert c.init_env.get("__OW_ACTION_VERSION") == "0.0.1"
+            assert c.run_env.get("api_key") == ident.authkey.compact
+            assert c.run_env.get("action_version") == "0.0.1"
+            assert "deadline" in c.run_env
+        asyncio.run(go())
+
+    def test_annotation_false_withholds_key(self):
+        async def go():
+            ann = Parameters({PROVIDE_API_KEY_ANNOTATION: ParameterValue(False)})
+            c, _ = await _drive(_action(annotations=ann))
+            assert "__OW_API_KEY" not in c.init_env
+            assert "api_key" not in c.run_env
+            # non-secret context still flows
+            assert c.run_env.get("namespace") == "guest"
+        asyncio.run(go())
+
+    def test_annotation_true_provides_key(self):
+        async def go():
+            ann = Parameters({PROVIDE_API_KEY_ANNOTATION: ParameterValue(True)})
+            c, ident = await _drive(_action(annotations=ann))
+            assert c.init_env.get("__OW_API_KEY") == ident.authkey.compact
+        asyncio.run(go())
+
+    def test_truthy_non_boolean_annotation_provides_key(self):
+        # ref Parameter.scala:119-127 isTruthy: nonempty strings are truthy
+        async def go():
+            ann = Parameters({PROVIDE_API_KEY_ANNOTATION: ParameterValue("yes")})
+            c, ident = await _drive(_action(annotations=ann))
+            assert c.init_env.get("__OW_API_KEY") == ident.authkey.compact
+        asyncio.run(go())
+
+    @pytest.mark.parametrize("falsy", ["", 0, None], ids=["empty-str", "zero", "null"])
+    def test_falsy_annotation_values_withhold_key(self, falsy):
+        async def go():
+            ann = Parameters({PROVIDE_API_KEY_ANNOTATION: ParameterValue(falsy)})
+            c, _ = await _drive(_action(annotations=ann))
+            assert "__OW_API_KEY" not in c.init_env
+            assert "api_key" not in c.run_env
+        asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# REST-level: the stamp survives a routine update that omits annotations
+# (ref Actions.scala:555 `content.annotations getOrElse action.annotations`)
+# ---------------------------------------------------------------------------
+
+class TestStampSurvivesUpdate:
+    def test_update_without_annotations_inherits(self, monkeypatch):
+        import base64
+
+        import aiohttp
+
+        from openwhisk_tpu.standalone import (GUEST_KEY, GUEST_UUID,
+                                              make_standalone)
+
+        monkeypatch.setenv(FLAG_ENV, "true")
+        auth = "Basic " + base64.b64encode(
+            f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+        hdrs = {"Authorization": auth, "Content-Type": "application/json"}
+        port = 13239
+        base = f"http://127.0.0.1:{port}/api/v1"
+        code = "def main(args):\n    return {}\n"
+
+        async def go():
+            controller = await make_standalone(port=port)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.put(f"{base}/namespaces/_/actions/ff",
+                                     headers=hdrs,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": code},
+                                           "limits": {"timeout": 300_000},
+                                           "publish": True}) as r:
+                        created = await r.json()
+                    async with s.put(
+                            f"{base}/namespaces/_/actions/ff?overwrite=true",
+                            headers=hdrs,
+                            json={"exec": {"kind": "python:3",
+                                           "code": code}}) as r:
+                        updated = await r.json()
+                    return created, updated
+            finally:
+                await controller.stop()
+
+        created, updated = asyncio.run(go())
+        stamped = {a["key"]: a["value"] for a in created["annotations"]}
+        assert stamped[PROVIDE_API_KEY_ANNOTATION] is False
+        assert stamped[EXEC_ANNOTATION] == "python:3"
+        inherited = {a["key"]: a["value"] for a in updated["annotations"]}
+        assert inherited[PROVIDE_API_KEY_ANNOTATION] is False
+        # every omitted field inherits (ref WhiskActionPut `getOrElse old`):
+        # an exec-only update must not reset limits or unpublish
+        assert updated["limits"]["timeout"] == 300_000
+        assert updated["publish"] is True
+
+
+class TestExecOptionalOnUpdate:
+    def test_field_only_update_inherits_exec(self, monkeypatch):
+        import base64
+
+        import aiohttp
+
+        from openwhisk_tpu.standalone import (GUEST_KEY, GUEST_UUID,
+                                              make_standalone)
+
+        monkeypatch.delenv(FLAG_ENV, raising=False)
+        auth = "Basic " + base64.b64encode(
+            f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+        hdrs = {"Authorization": auth, "Content-Type": "application/json"}
+        port = 13241
+        base = f"http://127.0.0.1:{port}/api/v1"
+        code = "def main(args):\n    return {}\n"
+
+        async def go():
+            controller = await make_standalone(port=port)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    # create without exec -> 400 (unchanged)
+                    async with s.put(f"{base}/namespaces/_/actions/noexec",
+                                     headers=hdrs, json={"publish": True}) as r:
+                        create_status = r.status
+                    async with s.put(f"{base}/namespaces/_/actions/fx",
+                                     headers=hdrs,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": code}}) as r:
+                        assert r.status == 200
+                    # parameters-only update inherits old.exec
+                    async with s.put(
+                            f"{base}/namespaces/_/actions/fx?overwrite=true",
+                            headers=hdrs,
+                            json={"parameters": [{"key": "p", "value": 1}]}) as r:
+                        return create_status, r.status, await r.json()
+            finally:
+                await controller.stop()
+
+        create_status, update_status, updated = asyncio.run(go())
+        assert create_status == 400
+        assert update_status == 200
+        assert updated["exec"]["kind"] == "python:3"
+        assert updated["exec"]["code"] == code
+        assert updated["version"] == "0.0.2"
+        params = {p["key"]: p["value"] for p in updated["parameters"]}
+        assert params == {"p": 1}

@@ -236,3 +236,78 @@ def test_actionproxy_failed_reinit_leaves_previous_action_working():
             sys.path.remove(wd)
         actionproxy._state["workdir"] = saved
         sys.modules.pop("helper", None)
+
+
+def test_invoker_executes_routed_revision_not_stale_cache():
+    """An invoker whose EntityStore cache holds rev-1 of an action must reload
+    when the ActivationMessage routes rev-2 (ref InvokerReactive.scala:244-258:
+    the fetch is revision-keyed; a warm fleet must never keep executing deleted
+    code). Before the fix, each standalone invoker had a private cache with no
+    invalidation wiring, so updated actions never took effect."""
+    from openwhisk_tpu.core.entity import CodeExec, WhiskAction
+    from openwhisk_tpu.database.entities import EntityStore
+
+    async def go():
+        st = SqliteArtifactStore()
+        es_controller = EntityStore(st)
+        es_invoker = EntityStore(st)  # separate cache, as in make_standalone
+        a = WhiskAction(EntityPath("ns"), EntityName("a"),
+                        CodeExec(kind="python:3", code="v1"))
+        rev1 = await es_controller.put(a)
+        # warm the invoker-side cache at rev 1
+        got1 = await es_invoker.get_action("ns/a", rev=rev1.rev)
+        assert got1.exec.code == "v1"
+        # controller updates the action -> rev 2
+        a2 = await es_controller.get_action("ns/a")
+        a2.exec = CodeExec(kind="python:3", code="v2")
+        a2.version = a2.version.up_patch()
+        rev2 = await es_controller.put(a2)
+        # a message routing rev2 must not serve the stale cached rev1
+        got2 = await es_invoker.get_action("ns/a", rev=rev2.rev)
+        assert got2.exec.code == "v2"
+        assert got2.rev.rev == rev2.rev
+        # and a rev-less fetch still serves the (now fresh) cache
+        got3 = await es_invoker.get_action("ns/a")
+        assert got3.exec.code == "v2"
+    run(go())
+
+
+def test_rev_guard_does_not_thrash_on_older_routed_rev():
+    """A backlog of old-rev activations draining after an update must be
+    served from the (newer) cache, not invalidate it per message; only a
+    cached generation OLDER than the routed one reloads."""
+    from openwhisk_tpu.database.entities import _rev_older_than
+
+    assert _rev_older_than("1-abc", "2-def") is True
+    assert _rev_older_than("2-def", "1-abc") is False   # newer cache: serve
+    assert _rev_older_than("2-def", "2-def") is False
+    assert _rev_older_than(None, "1-abc") is True
+    assert _rev_older_than("garbage", "also-garbage") is True  # conservative reload
+
+    from openwhisk_tpu.core.entity import CodeExec, WhiskAction
+    from openwhisk_tpu.database.entities import EntityStore
+
+    async def go():
+        st = SqliteArtifactStore()
+        es = EntityStore(st)
+        a = WhiskAction(EntityPath("ns"), EntityName("b"),
+                        CodeExec(kind="python:3", code="v1"))
+        rev1 = await es.put(a)
+        a2 = await es.get_action("ns/b")
+        a2.exec = CodeExec(kind="python:3", code="v2")
+        rev2 = await es.put(a2)
+        # cache holds rev2; an old-rev message must NOT evict it
+        loads = 0
+        orig_get = st.get
+
+        async def counting_get(doc_id):
+            nonlocal loads
+            loads += 1
+            return await orig_get(doc_id)
+
+        st.get = counting_get
+        got = await es.get_action("ns/b", rev=rev1.rev)
+        assert got.exec.code == "v2" and loads == 0
+        got = await es.get_action("ns/b", rev=rev2.rev)
+        assert got.exec.code == "v2" and loads == 0
+    run(go())
