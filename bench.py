@@ -231,10 +231,28 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         await bal.start()
         feeds = []
         producer = provider.get_producer()
+        instances = []
         for i in range(n_invokers):
             inst = InvokerInstanceId(i, user_memory=MB(8192))
+            instances.append(inst)
             feeds.append(await echo_invoker(provider, inst))
             await producer.send("health", PingMessage(inst))
+
+        # keep pinging at 1 Hz for the whole run (as real invokers do) —
+        # supervision marks a fleet Offline after 10 s of silence, which a
+        # cold first compile of the device program can easily outlast
+        stop_ping = asyncio.Event()
+
+        async def pinger():
+            while not stop_ping.is_set():
+                for inst in instances:
+                    await producer.send("health", PingMessage(inst))
+                try:
+                    await asyncio.wait_for(stop_ping.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    pass
+
+        ping_task = asyncio.ensure_future(pinger())
         # wait until supervision has actually registered the fleet (a fixed
         # sleep races the first device-program compile on slow channels)
         from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
@@ -271,6 +289,8 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         t0 = time.perf_counter()
         await asyncio.gather(*[one(i) for i in range(total)])
         wall = time.perf_counter() - t0
+        stop_ping.set()
+        await ping_task
         await bal.close()
         for f in feeds:
             await f.stop()
