@@ -335,6 +335,73 @@ class ControllerApi:
             return await self._invoke_action(request, ns, fqn)
         return _error(405, "method not allowed")
 
+    async def _check_sequence_limits(self, request, fqn, ns, components):
+        """Validate a sequence at PUT (ref Actions.scala:588-673
+        checkSequenceActionLimits): a sequence must have components; the
+        atomic-action count — computed by inlining nested sequences — must
+        stay within the sequence limit; no component may refer (directly or
+        through nested sequences) back to the sequence being created, and
+        every component must exist. Recursion terminates because pre-existing
+        sequences were validated at their own PUT, so any cycle must pass
+        through `fqn`. Returns an error response, or None when valid."""
+        limit = self.c.action_sequence_limit
+        transid = request["transid"]
+        if not components:
+            return _error(400, "No component specified for the sequence.",
+                          transid)
+        if len(components) > limit:
+            return _error(400, "Too many actions in the sequence.", transid)
+        seq_key = str(fqn)
+
+        class _Invalid(Exception):
+            def __init__(self, message):
+                self.message = message
+
+        async def count_atomic(root) -> int:
+            # iterative traversal: Python recursion would overflow on a deep
+            # (legal) chain of nested sequences, and the path-scoped visited
+            # set makes traversal of an already-corrupted graph (a cycle
+            # committed by racing PUTs) fail as cyclic instead of looping —
+            # the Scala reference re-recurses forever on that graph
+            total = 0
+            on_path = {seq_key}
+            stack = [(iter(root), None)]  # (component iterator, owner key)
+            while stack:
+                it, owner = stack[-1]
+                c = next(it, None)
+                if c is None:
+                    stack.pop()
+                    if owner is not None:
+                        on_path.discard(owner)
+                    continue
+                resolved = c.resolve(ns)
+                if str(resolved) in on_path:
+                    raise _Invalid("Sequence may not refer to itself.")
+                try:
+                    comp, _ = await resolve_action(
+                        self.c.entity_store, resolved, request["identity"])
+                except NoDocumentException:
+                    raise _Invalid("Sequence component does not exist.")
+                # a binding alias resolves to the real action: compare that
+                # identity too, so aliased self-references are still cycles
+                real = str(comp.fully_qualified_name)
+                if real in on_path:
+                    raise _Invalid("Sequence may not refer to itself.")
+                if comp.is_sequence:
+                    on_path.add(real)
+                    stack.append((iter(comp.exec.components), real))
+                else:
+                    total += 1
+                    if total > limit:
+                        raise _Invalid("Too many actions in the sequence.")
+            return total
+
+        try:
+            await count_atomic(components)
+        except _Invalid as e:
+            return _error(400, e.message, transid)
+        return None
+
     async def _put_action(self, request, ns, fqn):
         await self._check(request, PUT, ns)
         overwrite = self._bool_param(request, "overwrite")
@@ -360,8 +427,10 @@ class ControllerApi:
                 self.c.entitlement.check_kind(request["identity"], exec_.kind)
             if isinstance(exec_, SequenceExec):
                 exec_.components = [c.resolve(ns) for c in exec_.components]
-                if len(exec_.components) > self.c.action_sequence_limit:
-                    raise LimitViolation("too many actions in the sequence")
+                err = await self._check_sequence_limits(
+                    request, fqn, ns, exec_.components)
+                if err is not None:
+                    return err
         elif old is not None:
             # exec, like every other field, is optional on update
             # (ref WhiskActionPut: `content.exec getOrElse action.exec`)
