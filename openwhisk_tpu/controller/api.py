@@ -30,7 +30,8 @@ from ..core.entity.action import ActionLimits
 from ..core.entity.names import FullyQualifiedEntityName
 from ..database import DocumentConflict, NoDocumentException
 from ..utils.transaction import TransactionId
-from .entitlement import ACTIVATE, DELETE, EntitlementException, PUT, READ
+from .entitlement import (ACTIVATE, DELETE, EntitlementException, PUT, READ,
+                          RejectRequest)
 from .loadbalancer.base import LoadBalancerException
 from .invoke import resolve_action
 from .routemgmt import ApiManagementException
@@ -129,7 +130,8 @@ class ControllerApi:
     async def _auth_middleware(self, request: web.Request, handler):
         if request.path in ("/ping", "/api/v1", "/metrics",
                             "/api/v1/api-docs") or \
-                request.path.startswith("/api/v1/web/"):
+                request.path.startswith("/api/v1/web/") or \
+                request.path in self.c.public_extra_paths:
             return await handler(request)
         identity = await self.c.authenticator.identity_from_header(
             request.headers.get("Authorization"))
@@ -357,6 +359,34 @@ class ControllerApi:
             def __init__(self, message):
                 self.message = message
 
+        identity = request["identity"]
+        own_ns = str(identity.namespace.name)
+
+        async def check_component_readable(resolved) -> None:
+            """Cross-namespace components need READ entitlement or a
+            published provider package — checked BEFORE resolution, with one
+            403 for missing and unauthorized alike, so a foreign caller
+            cannot probe which private actions exist (ref Actions.scala PUT:
+            entitlement on ReferencedEntities precedes lookup; publicity is
+            package-level, same rule as cross-namespace binds above)."""
+            comp_ns = resolved.path.root_str
+            if comp_ns == own_ns:
+                return
+            try:
+                await self.c.entitlement.check(identity, READ, comp_ns)
+                return
+            except RejectRequest:
+                segs = resolved.path.segments
+                if len(segs) == 2:
+                    try:
+                        provider = await self.c.entity_store.get_package(
+                            f"{segs[0]}/{segs[1]}")
+                        if provider.publish:
+                            return
+                    except NoDocumentException:
+                        pass
+                raise
+
         async def count_atomic(root) -> int:
             # iterative traversal: Python recursion would overflow on a deep
             # (legal) chain of nested sequences, and the path-scoped visited
@@ -366,6 +396,7 @@ class ControllerApi:
             total = 0
             on_path = {seq_key}
             stack = [(iter(root), None)]  # (component iterator, owner key)
+            fetched = {}  # str(resolved) -> action: diamonds resolve once
             while stack:
                 it, owner = stack[-1]
                 c = next(it, None)
@@ -377,11 +408,15 @@ class ControllerApi:
                 resolved = c.resolve(ns)
                 if str(resolved) in on_path:
                     raise _Invalid("Sequence may not refer to itself.")
-                try:
-                    comp, _ = await resolve_action(
-                        self.c.entity_store, resolved, request["identity"])
-                except NoDocumentException:
-                    raise _Invalid("Sequence component does not exist.")
+                comp = fetched.get(str(resolved))
+                if comp is None:
+                    await check_component_readable(resolved)
+                    try:
+                        comp, _ = await resolve_action(
+                            self.c.entity_store, resolved, identity)
+                    except NoDocumentException:
+                        raise _Invalid("Sequence component does not exist.")
+                    fetched[str(resolved)] = comp
                 # a binding alias resolves to the real action: compare that
                 # identity too, so aliased self-references are still cycles
                 real = str(comp.fully_qualified_name)

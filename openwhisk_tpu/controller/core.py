@@ -38,7 +38,7 @@ class Controller:
                  invocations_per_minute: int = 60,
                  concurrent_invocations: int = 30,
                  fires_per_minute: int = 60,
-                 log_store=None):
+                 log_store=None, extra_routes=None):
         self.instance = instance
         self.provider = messaging_provider
         self.logger = logger or Logging()
@@ -82,6 +82,12 @@ class Controller:
         self.api = ControllerApi(self)
         self._runner: Optional[web.AppRunner] = None
         self.membership = None
+        # (method, path, handler) triples mounted beside /api/v1 at start —
+        # the seam the standalone playground UI plugs into. These are
+        # operator-mounted dev/ops pages, served without platform auth (the
+        # playground page authenticates its own API calls)
+        self.extra_routes = list(extra_routes or [])
+        self.public_extra_paths = {path for _, path, _ in self.extra_routes}
         # resources an assembler (e.g. standalone) co-locates with this
         # controller; each must expose an async stop()
         self.owned_resources: list = []
@@ -134,6 +140,8 @@ class Controller:
                 logger=self.logger)
             self.membership.start()
         app = self.api.make_app()
+        for method, path, handler in self.extra_routes:
+            app.router.add_route(method, path, handler)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)

@@ -38,7 +38,7 @@ def guest_identity() -> Identity:
 async def make_standalone(port: int = 3233, artifact_store=None,
                           user_memory_mb: int = 2048, logger=None,
                           prewarm: bool = False, manifest: Optional[dict] = None,
-                          balancer: str = "lean",
+                          balancer: str = "lean", ui: bool = True,
                           **controller_kw) -> Controller:
     """Assemble and start a standalone server; returns the running Controller.
 
@@ -73,6 +73,10 @@ async def make_standalone(port: int = 3233, artifact_store=None,
     else:
         lb = LeanBalancer(provider, instance, invoker_factory, logger=logger,
                           user_memory=MB(user_memory_mb))
+    if ui and "extra_routes" not in controller_kw:
+        # playground dev UI beside /api/v1 (ref standalone PlaygroundLauncher)
+        from .playground import playground_routes
+        controller_kw["extra_routes"] = playground_routes(GUEST_UUID, GUEST_KEY)
     controller = Controller(instance, provider, artifact_store=artifact_store,
                             logger=logger, load_balancer=lb, **controller_kw)
     # seed the guest identity

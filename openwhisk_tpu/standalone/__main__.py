@@ -9,6 +9,40 @@ from ..utils.config import honor_jax_platforms_env
 from ..utils.tasks import wait_for_shutdown
 
 
+def preflight(port: int) -> bool:
+    """Boot-time environment checks (ref standalone PreFlightChecks): each
+    prints one OK/FAIL line; returns False when any check fails."""
+    import shutil
+    import socket
+
+    from ..core.entity import ExecManifest
+
+    ok = True
+
+    def check(name, passed, hint=""):
+        nonlocal ok
+        print(f"  [{'OK' if passed else 'FAIL'}] {name}" +
+              (f" — {hint}" if (hint and not passed) else ""))
+        ok = ok and passed
+
+    try:
+        with socket.socket() as s:
+            # match the server's bind semantics (asyncio sets SO_REUSEADDR),
+            # else lingering TIME_WAIT sockets false-fail a quick restart
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", port))
+        free = True
+    except OSError:
+        free = False
+    check(f"port {port} available", free,
+          "another process is listening — pick --port")
+    check("python3 for action sandboxes",
+          shutil.which("python3") is not None, "python3 not on PATH")
+    ExecManifest.initialize(None)
+    print(f"  runtimes: {', '.join(ExecManifest.runtimes().kinds)}")
+    return ok
+
+
 def main() -> None:
     honor_jax_platforms_env()
     parser = argparse.ArgumentParser(description="Standalone OpenWhisk-TPU server")
@@ -22,7 +56,13 @@ def main() -> None:
     parser.add_argument("--balancer", choices=("lean", "tpu"), default="lean",
                         help="load balancer: lean (in-process) or tpu "
                              "(device placement kernel)")
+    parser.add_argument("--no-ui", action="store_true",
+                        help="do not serve the /playground dev UI")
     args = parser.parse_args()
+
+    print("preflight:")
+    if not preflight(args.port):
+        raise SystemExit(1)
 
     async def run():
         from ..utils.tracing import maybe_enable_zipkin
@@ -37,11 +77,14 @@ def main() -> None:
                                                artifact_store=store,
                                                user_memory_mb=args.memory,
                                                prewarm=args.prewarm,
-                                               balancer=args.balancer)
+                                               balancer=args.balancer,
+                                               ui=not args.no_ui)
             print(f"OpenWhisk-TPU standalone listening on :{args.port} "
                   f"(balancer={args.balancer})")
             print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")
             print(f"  API      http://127.0.0.1:{args.port}/api/v1")
+            if not args.no_ui:
+                print(f"  UI       http://127.0.0.1:{args.port}/playground")
             await wait_for_shutdown()
         finally:
             if controller is not None:

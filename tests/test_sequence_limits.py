@@ -161,3 +161,57 @@ class TestTraversalRobustness:
         status, body = asyncio.run(corrupting_run())
         assert status == 400
         assert body["error"] == "Sequence may not refer to itself."
+
+
+class TestCrossNamespaceComponents:
+    """Cross-namespace sequence components are entitlement-gated BEFORE
+    resolution (ref Actions.scala PUT entitlement on ReferencedEntities):
+    missing and private both answer 403, so a foreign caller cannot probe
+    which actions exist; only a published provider package shares."""
+
+    def test_cross_ns_policy(self):
+        from openwhisk_tpu.core.entity import (CodeExec, EntityName,
+                                               EntityPath, WhiskAction,
+                                               WhiskPackage)
+
+        async def go():
+            controller = await make_standalone(port=PORT)
+            try:
+                es = controller.entity_store
+                # namespace bob: a private action, a private package, and a
+                # published package, each holding one atomic action
+                await es.put(WhiskAction(EntityPath("bob"), EntityName("secret"),
+                                         CodeExec(kind="python:3", code="x")))
+                await es.put(WhiskPackage(EntityPath("bob"), EntityName("priv"),
+                                          publish=False))
+                await es.put(WhiskAction(EntityPath("bob/priv"),
+                                         EntityName("hidden"),
+                                         CodeExec(kind="python:3", code="x")))
+                await es.put(WhiskPackage(EntityPath("bob"), EntityName("pub"),
+                                          publish=True))
+                await es.put(WhiskAction(EntityPath("bob/pub"),
+                                         EntityName("tool"),
+                                         CodeExec(kind="python:3", code="x")))
+                async with aiohttp.ClientSession() as s:
+                    out = {}
+                    for key, comp in [("private", "/bob/secret"),
+                                      ("missing", "/bob/nothere"),
+                                      ("priv_pkg", "/bob/priv/hidden"),
+                                      ("missing_pkg", "/bob/ghost/tool"),
+                                      ("pub_pkg", "/bob/pub/tool")]:
+                        st, body = await _mk_seq(s, f"x{key}", [comp])
+                        out[key] = (st, body.get("error", ""))
+                    return out
+            finally:
+                await controller.stop()
+
+        out = asyncio.run(go())
+        assert out["pub_pkg"][0] == 200, out["pub_pkg"]
+        # everything else is the SAME 403 — no existence oracle
+        for key in ("private", "missing", "priv_pkg", "missing_pkg"):
+            st, err = out[key]
+            assert st == 403, (key, out[key])
+            assert "not authorized" in err, (key, err)
+        errs = {out[k][1] for k in ("private", "missing", "priv_pkg",
+                                    "missing_pkg")}
+        assert len(errs) == 1, f"responses must be indistinguishable: {errs}"
