@@ -451,7 +451,11 @@ class ControllerApi:
         if old is not None and not overwrite:
             return _error(409, "resource already exists", request["transid"])
         if "exec" in body:
-            exec_ = Exec.from_json(body["exec"])
+            try:
+                exec_ = Exec.from_json(body["exec"])
+            except ValueError as e:
+                # e.g. an unparsable component FQN in a sequence
+                return _error(400, f"malformed exec: {e}", request["transid"])
             if exec_.kind not in ("sequence", "blackbox"):
                 resolved = ExecManifest.runtimes().resolve_default(exec_.kind)
                 if not ExecManifest.runtimes().knows(resolved):

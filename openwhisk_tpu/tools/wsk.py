@@ -110,13 +110,60 @@ async def run(args) -> int:
     e = args.entity
     if e == "action":
         if args.cmd in ("create", "update"):
-            code = open(args.artifact).read()
-            kind = args.kind or ("python:3" if args.artifact.endswith(".py")
-                                 else "nodejs:14")
-            body = {"exec": {"kind": kind, "code": code},
-                    "parameters": _kv_list(_params_to_dict(args.param)),
-                    "annotations": _kv_list(_params_to_dict(args.annotation))}
+            if args.sequence and args.artifact:
+                print("error: --sequence and a code artifact are mutually "
+                      "exclusive", file=sys.stderr)
+                return 2
+            if args.sequence:
+                # `wsk action create seq --sequence a,b,c` (reference CLI);
+                # names resolve like feed references: leading slash =
+                # qualified, else relative to the caller's namespace (so
+                # `pkg/name` is a package in OUR namespace, not namespace
+                # `pkg`)
+                comps = []
+                for raw in args.sequence.split(","):
+                    c = raw.strip()
+                    if not c:
+                        print(f"error: empty component in --sequence "
+                              f"{args.sequence!r}", file=sys.stderr)
+                        return 2
+                    try:
+                        comp_ns, path = _feed_action_path(c, "_")
+                    except ValueError as err:
+                        print(f"error: {err}", file=sys.stderr)
+                        return 2
+                    comps.append(f"{comp_ns}/{path}")
+                exec_ = {"kind": "sequence", "components": comps}
+            elif args.artifact:
+                code = open(args.artifact).read()
+                kind = args.kind or ("python:3" if args.artifact.endswith(".py")
+                                     else "nodejs:14")
+                exec_ = {"kind": kind, "code": code}
+            elif args.cmd == "update":
+                exec_ = None  # field-only update inherits the stored exec
+            else:
+                print("error: an artifact file or --sequence is required",
+                      file=sys.stderr)
+                return 2
+            # an update sends only the fields the user asked to change —
+            # the API inherits everything omitted from the stored action
+            body = {}
+            if exec_ is not None:
+                body["exec"] = exec_
+            if args.cmd == "create" or args.param:
+                body["parameters"] = _kv_list(_params_to_dict(args.param))
+            if args.cmd == "create" or args.annotation or args.web:
+                body["annotations"] = _kv_list(_params_to_dict(args.annotation))
             if args.web:
+                if args.cmd == "update" and not args.annotation:
+                    # --web alone must merge into the stored annotations, not
+                    # wipe them (the API replaces the field when present)
+                    st, doc = await client.request(
+                        "GET", f"/namespaces/{ns}/actions/{args.name}")
+                    if st == 200:
+                        body["annotations"] = [
+                            a for a in doc.get("annotations", [])
+                            if a.get("key") != "web-export"]
                 body["annotations"].append({"key": "web-export", "value": True})
             if args.memory:
                 body.setdefault("limits", {})["memory"] = args.memory
@@ -335,6 +382,9 @@ def main(argv=None) -> int:
     parser.add_argument("--annotation", "-a", nargs=2, action="append",
                         metavar=("K", "V"))
     parser.add_argument("--kind", default=None)
+    parser.add_argument("--sequence", default=None, metavar="A,B,C",
+                        help="action create/update: comma-separated component "
+                             "actions (creates a sequence)")
     parser.add_argument("--web", action="store_true")
     parser.add_argument("--memory", "-m", type=int, default=None)
     parser.add_argument("--timeout", "-t", type=int, default=None)

@@ -1,0 +1,105 @@
+"""`wsk action create --sequence` and field-only updates through the CLI
+(ref wsk CLI sequence flag; updates send only the requested fields so the
+API's inherit-omitted-fields rule applies)."""
+import asyncio
+import base64
+import os
+import tempfile
+
+import aiohttp
+
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID, make_standalone
+from openwhisk_tpu.tools import wsk
+
+AUTH_PAIR = f"{GUEST_UUID}:{GUEST_KEY}"
+AUTH = "Basic " + base64.b64encode(AUTH_PAIR.encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+PORT = 13287
+HOST = f"http://127.0.0.1:{PORT}"
+BASE = f"{HOST}/api/v1"
+
+STEP = "def main(args):\n    return {'n': args.get('n', 0) + 1}\n"
+
+
+async def _wsk(*argv) -> int:
+    return await asyncio.to_thread(
+        wsk.main, ["--apihost", HOST, "--auth", AUTH_PAIR, *argv])
+
+
+def test_sequence_create_and_field_only_update():
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(STEP)
+        step_file = f.name
+
+    async def go():
+        controller = await make_standalone(port=PORT)
+        try:
+            async with aiohttp.ClientSession() as s:
+                assert await _wsk("action", "create", "step", step_file) == 0
+                # --sequence builds a sequence without an artifact file
+                assert await _wsk("action", "create", "twice",
+                                  "--sequence", "step,step") == 0
+                async with s.post(
+                        f"{BASE}/namespaces/_/actions/twice"
+                        "?blocking=true&result=true",
+                        headers=HDRS, json={"n": 5}) as r:
+                    assert r.status == 200
+                    assert await r.json() == {"n": 7}
+                # a cyclic sequence is rejected by the API -> CLI exit 1
+                assert await _wsk("action", "create", "loop",
+                                  "--sequence", "loop") == 1
+                # field-only update: no artifact, no exec — parameters change,
+                # the stored exec (and the sequence) survive
+                assert await _wsk("action", "update", "twice",
+                                  "-p", "tag", "v2") == 0
+                async with s.get(f"{BASE}/namespaces/_/actions/twice",
+                                 headers=HDRS) as r:
+                    doc = await r.json()
+                    assert doc["exec"]["kind"] == "sequence"
+                    assert doc["version"] == "0.0.2"
+                    params = {p["key"]: p["value"] for p in doc["parameters"]}
+                    assert params == {"tag": "v2"}
+                # create with neither artifact nor --sequence: usage error
+                assert await _wsk("action", "create", "naked") == 2
+                # conflicting artifact + --sequence: usage error
+                assert await _wsk("action", "create", "both", step_file,
+                                  "--sequence", "step") == 2
+                # empty component: usage error, not a server 500
+                assert await _wsk("action", "create", "holey",
+                                  "--sequence", "step,") == 2
+                # package-relative component resolves within OUR namespace
+                async with s.put(f"{BASE}/namespaces/_/packages/utils",
+                                 headers=HDRS, json={}) as r:
+                    assert r.status == 200
+                async with s.put(f"{BASE}/namespaces/_/actions/utils/split",
+                                 headers=HDRS,
+                                 json={"exec": {"kind": "python:3",
+                                                "code": STEP}}) as r:
+                    assert r.status == 200
+                assert await _wsk("action", "create", "pkgseq",
+                                  "--sequence", "utils/split") == 0
+                async with s.get(f"{BASE}/namespaces/_/actions/pkgseq",
+                                 headers=HDRS) as r:
+                    doc = await r.json()
+                    assert doc["exec"]["components"] == ["guest/utils/split"]
+                # update --web alone merges into stored annotations
+                assert await _wsk("action", "update", "step",
+                                  "-a", "description", "keep-me") == 0
+                assert await _wsk("action", "update", "step", "--web") == 0
+                async with s.get(f"{BASE}/namespaces/_/actions/step",
+                                 headers=HDRS) as r:
+                    ann = {a["key"]: a["value"]
+                           for a in (await r.json())["annotations"]}
+                    assert ann.get("description") == "keep-me"
+                    assert ann.get("web-export") is True
+                # a malformed component through the RAW API is a 400, not 500
+                async with s.put(f"{BASE}/namespaces/_/actions/rawbad",
+                                 headers=HDRS,
+                                 json={"exec": {"kind": "sequence",
+                                                "components": ["_/"]}}) as r:
+                    assert r.status == 400, await r.text()
+        finally:
+            await controller.stop()
+            os.unlink(step_file)
+
+    asyncio.run(go())
