@@ -46,8 +46,8 @@ from ...messaging.message import ActivationMessage
 from ...models.sharding_policy import (MIN_SLOT_MB, generate_hash,
                                        pairwise_coprimes)
 from ...ops.placement import (PlacementState, RequestBatch, init_state,
-                              make_fused_step, release_batch, schedule_batch,
-                              set_health)
+                              make_fused_step_packed, make_release_packed,
+                              release_batch, schedule_batch, set_health)
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException)
 from .supervision import InvokerPool
@@ -160,7 +160,8 @@ class TpuBalancer(CommonLoadBalancer):
                  managed_fraction: float = 0.9, blackbox_fraction: float = 0.1,
                  batch_window: float = 0.002, max_batch: int = 256,
                  action_slots: int = 4096, max_action_slots: int = 65536,
-                 initial_pad: int = 64, mesh=None, kernel: str = "xla"):
+                 initial_pad: int = 64, mesh=None, kernel: str = "xla",
+                 pipeline_depth: int = 4):
         super().__init__(messaging_provider, controller_instance, logger, metrics)
         self._cluster_size = cluster_size
         self.kernel = kernel  # "xla" | "pallas" (single-device only)
@@ -189,6 +190,12 @@ class TpuBalancer(CommonLoadBalancer):
         self._health_updates: Dict[int, bool] = {}
         self._flush_task: Optional[asyncio.Task] = None
         self._step_lock = asyncio.Lock()
+        # device-step pipelining: dispatch is async (JAX returns future
+        # arrays immediately), so batch N+1 can be dispatched while batch
+        # N's readback is still crossing the wire — the semaphore bounds
+        # in-flight readbacks, the task set tracks them for close()
+        self._inflight = asyncio.Semaphore(max(1, pipeline_depth))
+        self._readbacks: set = set()
 
         # group is per-controller: every controller needs its OWN full view
         # of the ping stream (a shared group would split pings between
@@ -238,16 +245,21 @@ class TpuBalancer(CommonLoadBalancer):
             self.state = state
             self._sched_fn = schedule_batch
             self._release_fn = release_batch
-        # release + health-fold + schedule as ONE compiled program (vs three
-        # dispatches per micro-batch)
-        self._fused_fn = make_fused_step(self._release_fn, self._sched_fn)
+        # release + health-fold + schedule as ONE compiled program (vs
+        # three dispatches per micro-batch), fed through the transfer-packed
+        # wrappers (3 host->device transfers per step instead of 16)
+        self._packed_fn = make_fused_step_packed(self._release_fn,
+                                                 self._sched_fn)
+        self._release_packed_fn = make_release_packed(self._release_fn)
 
     def _use_xla_kernels(self) -> None:
         """Swap the XLA schedule/release kernels in (pallas state outgrew
         the VMEM budget, via growth or snapshot restore)."""
         self._sched_fn = schedule_batch
         self._release_fn = release_batch
-        self._fused_fn = make_fused_step(self._release_fn, self._sched_fn)
+        self._packed_fn = make_fused_step_packed(self._release_fn,
+                                                 self._sched_fn)
+        self._release_packed_fn = make_release_packed(self._release_fn)
 
     def _pallas_fits(self) -> bool:
         from ...ops.placement_pallas import fits_vmem
@@ -377,6 +389,10 @@ class TpuBalancer(CommonLoadBalancer):
         await self.supervision.stop()
         if self._flush_task:
             self._flush_task.cancel()
+        # let in-flight readbacks resolve their publishers first
+        if self._readbacks:
+            await asyncio.gather(*list(self._readbacks),
+                                 return_exceptions=True)
         # fail queued publishers instead of leaving them awaiting forever
         pending, self._pending = self._pending, []
         for req, fut, slot_key in pending:
@@ -541,89 +557,134 @@ class TpuBalancer(CommonLoadBalancer):
             b *= 2
         return min(b, cap) if n <= cap else cap
 
-    def _release_arrays(self):
-        """Drain buffered releases into padded device arrays (+ host-side
-        slot bookkeeping)."""
+    def _release_packed(self) -> np.ndarray:
+        """Drain buffered releases into ONE packed int32[5,R] host array
+        (+ host-side slot bookkeeping) — same padding as _release_arrays."""
         cap = self.max_batch * 4
         rel, self._releases = self._releases[:cap], self._releases[cap:]
         b = self._bucket(len(rel), cap) if rel else 8
-        pad = b - len(rel)
-        arrays = (
-            jnp.asarray([r[0] for r in rel] + [0] * pad, jnp.int32),
-            jnp.asarray([r[1] for r in rel] + [0] * pad, jnp.int32),
-            jnp.asarray([r[2] for r in rel] + [0] * pad, jnp.int32),
-            jnp.asarray([r[3] for r in rel] + [1] * pad, jnp.int32),
-            jnp.asarray([True] * len(rel) + [False] * pad, bool))
+        out = np.zeros((5, b), np.int32)
+        out[3, len(rel):] = 1  # padded rows: maxc=1
+        for j, r in enumerate(rel):
+            out[0, j], out[1, j], out[2, j], out[3, j] = r[0], r[1], r[2], r[3]
+            out[4, j] = 1
         for r in rel:
             self._slots.release(r[4], r[1])
-        return arrays
+        return out
 
-    def _health_arrays(self):
-        """Drain up to HEALTH_BATCH buffered flips into fixed-shape arrays;
-        the remainder stays buffered for the next step."""
+    def _health_packed(self) -> np.ndarray:
+        """Drain up to HEALTH_BATCH flips into ONE packed int32[3,H] array —
+        same repeat-last padding rule as _health_arrays."""
         b = self.HEALTH_BATCH
         take = list(self._health_updates.items())[:b]
         for k, _ in take:
             del self._health_updates[k]
-        pad = b - len(take)
+        out = np.zeros((3, b), np.int32)
         if take:
-            # pad by REPEATING the last real entry: duplicate scatter indices
-            # are only deterministic when they write identical values (a
-            # masked "keep current" pad at index 0 would race a real update
-            # of invoker 0)
-            idxs = [k for k, _ in take]
-            vals = [v for _, v in take]
-            return (jnp.asarray(idxs + [idxs[-1]] * pad, jnp.int32),
-                    jnp.asarray(vals + [vals[-1]] * pad, bool),
-                    jnp.asarray([True] * b, bool))
-        return (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
-                jnp.zeros((b,), bool))
+            pad = b - len(take)
+            idxs = [k for k, _ in take] + [take[-1][0]] * pad
+            vals = [int(v) for _, v in take] + [int(take[-1][1])] * pad
+            out[0] = idxs
+            out[1] = vals
+            out[2] = 1
+        return out
 
     async def _device_step(self) -> None:
         if not self._pending:
             # nothing to schedule: fold releases (padded+masked like the
             # fused path) and health (exact-size; dict keys are unique)
             if self._releases:
-                self.state = self._release_fn(self.state,
-                                              *self._release_arrays())
+                self.state = self._release_packed_fn(self.state,
+                                                     self._release_packed())
             if self._health_updates:
                 ups, self._health_updates = self._health_updates, {}
                 self.state = set_health(self.state, list(ups.keys()),
                                         list(ups.values()))
             return
 
+        # bound dispatched-but-unread steps (permit released by the readback
+        # task) BEFORE popping the batch: a cancellation while waiting here
+        # (close() cancels the flush task) must leave the queue intact so
+        # close() can fail those publishers instead of stranding them
+        await self._inflight.acquire()
         batch, self._pending = self._pending[: self.max_batch], \
             self._pending[self.max_batch:]
         t0 = time.monotonic()
         reqs = [r for r, _, _ in batch]
         b = len(reqs)
         bp = self._bucket(b, self.max_batch)
-        pad_req = {"offset": 0, "size": 1, "home": 0, "step_inv": 0,
-                   "need_mb": 0, "conc_slot": 0, "max_conc": 1, "rand": 0}
-        reqs_p = reqs + [pad_req] * (bp - b)
-        cols = {k: jnp.asarray([r[k] for r in reqs_p], jnp.int32)
-                for k in ("offset", "size", "home", "step_inv", "need_mb",
-                          "conc_slot", "max_conc", "rand")}
-        rb = RequestBatch(cols["offset"], cols["size"], cols["home"],
-                          cols["step_inv"], cols["need_mb"], cols["conc_slot"],
-                          cols["max_conc"], cols["rand"],
-                          jnp.asarray([True] * b + [False] * (bp - b), bool))
-        # releases + health flips + schedule: ONE device program
-        ri, rs, rm, rc, rv = self._release_arrays()
-        hidx, hval, hmask = self._health_arrays()
-        self.state, chosen, forced = self._fused_fn(
-            self.state, ri, rs, rm, rc, rv, hidx, hval, hmask, rb)
+        # ONE packed request matrix: row layout must match
+        # make_fused_step_packed (offset..rand, valid); padded request
+        # columns keep size=1/max_conc=1 like the old pad_req dict
+        req_np = np.zeros((9, bp), np.int32)
+        req_np[1, b:] = 1  # size
+        req_np[6, b:] = 1  # max_conc
+        for j, r in enumerate(reqs):
+            req_np[0, j] = r["offset"]
+            req_np[1, j] = r["size"]
+            req_np[2, j] = r["home"]
+            req_np[3, j] = r["step_inv"]
+            req_np[4, j] = r["need_mb"]
+            req_np[5, j] = r["conc_slot"]
+            req_np[6, j] = r["max_conc"]
+            req_np[7, j] = r["rand"]
+            req_np[8, j] = 1
+        rel_np = self._release_packed()
+        health_np = self._health_packed()
+        # releases + health flips + schedule: ONE device program over THREE
+        # host->device transfers (the old column-wise path did 16 — on a
+        # tunneled chip the transfer round-trips dominated the step). No
+        # await between the pop above and the task creation below, so no
+        # cancellation window can orphan the popped batch.
+        try:
+            self.state, chosen, forced = self._packed_fn(
+                self.state, rel_np, health_np, req_np)
+        except Exception as e:  # noqa: BLE001 — a failed dispatch must not
+            # leak the permit or strand the publishers (device capacity from
+            # the drained releases is recovered by forced-timeout self-heal)
+            self._inflight.release()
+            for _, fut, _ in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        LoadBalancerException(f"device dispatch failed: {e}"))
+            if self.logger:
+                self.logger.error(None, f"device dispatch failed: {e!r}",
+                                  "TpuBalancer")
+            return
 
-        # readback on a worker thread: the event loop keeps serving acks,
-        # feeds and new publishes while the device (or tunnel) computes.
-        # The step lock is held, so no second step races the state. The
-        # step-duration stamp is taken ON the worker thread so the metric
-        # measures device step + readback, not loop re-scheduling delay.
+        # pipelined readback: dispatch returns future arrays immediately, so
+        # the NEXT batch can dispatch (chained on device) while this batch's
+        # results cross the wire on a worker thread — on a tunneled chip the
+        # round-trip dwarfs the compute, and serializing them caps
+        # throughput at batch/RTT. Dispatch stays event-loop-serialized
+        # under the step lock; only readbacks overlap.
+        task = asyncio.get_event_loop().create_task(
+            self._readback_step(batch, b, chosen, forced, t0))
+        self._readbacks.add(task)
+        task.add_done_callback(self._readbacks.discard)
+
+    async def _readback_step(self, batch, b, chosen, forced, t0) -> None:
+        # the step-duration stamp is taken ON the worker thread so the
+        # metric measures device step + readback, not loop re-scheduling
         def _read():
             out = (np.asarray(chosen), np.asarray(forced))
             return out, time.monotonic()
 
-        (chosen_np, forced_np), t_done = await asyncio.to_thread(_read)
+        try:
+            (chosen_np, forced_np), t_done = await asyncio.to_thread(_read)
+        except Exception as e:  # noqa: BLE001 — publishers must not hang
+            for _, fut, _ in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        LoadBalancerException(f"device step failed: {e}"))
+            self._inflight.release()
+            # already surfaced through the futures — re-raising would only
+            # produce unretrieved-task noise on the loop
+            if self.logger:
+                self.logger.error(None, f"device readback failed: {e!r}",
+                                  "TpuBalancer")
+            return
+        self._inflight.release()
         dt_ms = (t_done - t0) * 1e3
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)

@@ -207,3 +207,42 @@ def make_fused_step(release_fn=None, schedule_fn=None):
         return schedule_fn(state, batch)
 
     return fused
+
+
+def make_release_packed(release_fn=None):
+    """Release-only fold over the packed int32[5,R] matrix (inv, slot, mem,
+    maxc, valid) — the idle-drain counterpart of make_fused_step_packed."""
+    release_fn = release_fn or release_batch
+
+    @jax.jit
+    def packed(state: PlacementState, rel):
+        return release_fn(state, rel[0], rel[1], rel[2], rel[3],
+                          rel[4].astype(bool))
+
+    return packed
+
+
+def make_fused_step_packed(release_fn=None, schedule_fn=None):
+    """Transfer-packed variant of make_fused_step for the balancer's host
+    path. The unpacked signature costs 16 host->device transfers per step
+    (8 request columns + 5 release arrays + 3 health arrays); on a tunneled
+    device every transfer is a round trip, so the TRANSFER COUNT — not the
+    kernel — dominates the step. Packing collapses them to 3 int32 matrices
+    (releases [5,R], health [3,H], requests [9,B]); the row unpacking and
+    bool casts fuse into the same compiled program.
+    """
+    fused = make_fused_step(release_fn, schedule_fn)
+
+    @jax.jit
+    def packed(state: PlacementState, rel, health, req):
+        # rel    int32[5,R]: inv, slot, mem, maxc, valid
+        # health int32[3,H]: idx, val, mask
+        # req    int32[9,B]: offset, size, home, step_inv, need_mb,
+        #                    conc_slot, max_conc, rand, valid
+        batch = RequestBatch(req[0], req[1], req[2], req[3], req[4], req[5],
+                             req[6], req[7], req[8].astype(bool))
+        return fused(state, rel[0], rel[1], rel[2], rel[3],
+                     rel[4].astype(bool), health[0],
+                     health[1].astype(bool), health[2].astype(bool), batch)
+
+    return packed
