@@ -557,3 +557,65 @@ class TestHealthTestActions:
         small.restore(snap)
         assert small._sched_fn is schedule_batch
         assert small._release_fn is release_batch
+
+
+class TestPipelinedSteps:
+    """Device-step pipelining (dispatch N+1 while N's readback is in
+    flight): correctness across many overlapping micro-batches, and clean
+    shutdown with work queued or in flight."""
+
+    def test_many_overlapping_batches_all_place(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              batch_window=0.0005, max_batch=8,
+                              pipeline_depth=3)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action()
+            promises = [await bal.publish(action,
+                                          make_msg(action, ident, blocking=True))
+                        for _ in range(48)]
+            results = await asyncio.gather(*[asyncio.wait_for(p, 10)
+                                             for p in promises])
+            await asyncio.sleep(0.3)
+            leaked = bal.total_active_activations
+            slots = len(bal.activation_slots)
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return results, leaked, slots
+
+        results, leaked, slots = asyncio.run(go())
+        assert len(results) == 48
+        assert all(r.response.is_success for r in results)
+        assert leaked == 0 and slots == 0
+
+    def test_close_fails_queued_publishers_without_hanging(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            # a far-away batch window keeps the publishes queued
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              batch_window=30.0)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action()
+            tasks = [asyncio.create_task(
+                bal.publish(action, make_msg(action, ident, blocking=True)))
+                for _ in range(4)]
+            await asyncio.sleep(0.05)  # queued; window has not fired
+            await asyncio.wait_for(bal.close(), 5)  # must not hang
+            outcomes = await asyncio.gather(*tasks, return_exceptions=True)
+            for inv in invokers:
+                await inv.stop()
+            return outcomes
+
+        outcomes = asyncio.run(go())
+        assert len(outcomes) == 4
+        assert all(isinstance(o, LoadBalancerException) for o in outcomes)
