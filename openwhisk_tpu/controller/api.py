@@ -575,6 +575,10 @@ class ControllerApi:
             n = await self.c.activation_store.count(ns, name, since, upto)
             return web.json_response({"activations": n})
         docs = await self.c.activation_store.list(ns, name, skip, limit, since, upto)
+        if self._bool_param(request, "docs"):
+            # full records incl. response/logs (ref Activations.scala ?docs)
+            return web.json_response(
+                [WhiskActivation.from_json(d).to_json() for d in docs])
         summaries = [WhiskActivation.from_json(d).summary_json() for d in docs]
         return web.json_response(summaries)
 

@@ -48,30 +48,34 @@ class UserEventsRecorder:
         self._feed.start()
 
     def record(self, event: EventMessage) -> None:
-        """PrometheusRecorder.scala semantics: one series family per metric,
-        action-scoped for activations, namespace-scoped for throttles."""
+        """PrometheusRecorder.scala semantics: one series FAMILY per metric,
+        fanned out by Prometheus labels — `action` for activations,
+        `namespace`+`metric` for throttle events (the reference's Kamon tags
+        become label sets, so dashboards can `sum by (action)`)."""
         if event.event_type == "Activation":
             b = event.body
-            name = b.get("name", "unknown").replace("/", "_")
-            self.metrics.counter(f"userevents_activations_{name}_total")
+            tags = {"action": b.get("name", "unknown")}
+            self.metrics.counter("userevents_activations_total", tags=tags)
             self.metrics.counter(
-                f"userevents_activations_{name}_status_{b.get('statusCode', 0)}")
-            self.metrics.histogram(f"userevents_duration_{name}_ms",
-                                   b.get("duration", 0))
+                "userevents_activation_status_total",
+                tags={**tags, "status": str(b.get("statusCode", 0))})
+            self.metrics.histogram("userevents_duration_ms",
+                                   b.get("duration", 0), tags=tags)
             if b.get("waitTime"):
-                self.metrics.histogram(f"userevents_waitTime_{name}_ms",
-                                       b["waitTime"])
+                self.metrics.histogram("userevents_wait_time_ms",
+                                       b["waitTime"], tags=tags)
             if b.get("initTime"):
-                self.metrics.histogram(f"userevents_initTime_{name}_ms",
-                                       b["initTime"])
-                self.metrics.counter(f"userevents_coldStarts_{name}_total")
-            self.metrics.gauge("userevents_memory_" + name, b.get("memory", 0))
+                self.metrics.histogram("userevents_init_time_ms",
+                                       b["initTime"], tags=tags)
+                self.metrics.counter("userevents_cold_starts_total", tags=tags)
+            self.metrics.gauge("userevents_memory_mb", b.get("memory", 0),
+                               tags=tags)
         elif event.event_type == "Metric":
             b = event.body
-            ns = event.namespace.replace("/", "_")
             self.metrics.counter(
-                f"userevents_{b.get('metricName', 'unknown')}_{ns}",
-                int(b.get("metricValue", 1)))
+                "userevents_rate_limit_total", int(b.get("metricValue", 1)),
+                tags={"namespace": event.namespace,
+                      "metric": b.get("metricName", "unknown")})
 
     def prometheus_text(self) -> str:
         return self.metrics.prometheus_text()

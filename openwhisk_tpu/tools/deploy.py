@@ -35,6 +35,7 @@ DEFAULT_INVENTORY = {
     "controllers": {"count": 1, "base_port": 3233, "balancer": "tpu"},
     "invokers": {"count": 1, "memory_mb": 2048, "prewarm": False},
     "edge": {"enabled": True, "port": 8080, "domain": ""},
+    "monitoring": {"enabled": False, "port": 9096},
     "limits": {},   # e.g. invocationsPerMinute: 60  -> CONFIG_whisk_...
     "config": {},   # raw CONFIG_whisk_* overrides
 }
@@ -169,6 +170,15 @@ def services(inv: dict, python: str = sys.executable,
             if interval is not None:
                 argv += ["--balancer-snapshot-interval", str(interval)]
         out.append({"name": f"controller{i}", "argv": argv})
+    mon = inv.get("monitoring") or {}
+    if mon.get("enabled"):
+        # the user-events service (ref core/monitoring/user-events): consumes
+        # the events topic, serves Prometheus series on /metrics
+        out.append({"name": "monitoring",
+                    "argv": [python, "-m",
+                             "openwhisk_tpu.controller.monitoring",
+                             "--bus", bus_addr,
+                             "--port", str(mon.get("port", 9096))]})
     if inv["edge"].get("enabled", True):
         argv = [python, "-m", "openwhisk_tpu.edge",
                 "--port", str(inv["edge"]["port"]), "--controllers", *ctrl_urls]
@@ -279,7 +289,8 @@ def render_k8s(inv: dict, outdir: str) -> None:
              "spec": {"accessModes": ["ReadWriteMany"],
                       "resources": {"requests": {"storage": "1Gi"}}}}]
     ports = {"bus": inv["bus"]["port"], "edge": inv["edge"]["port"],
-             "docstore": (inv.get("docstore") or {}).get("port", 4223)}
+             "docstore": (inv.get("docstore") or {}).get("port", 4223),
+             "monitoring": (inv.get("monitoring") or {}).get("port", 9096)}
     # pods find each other via their Service DNS names, not loopback
     net = {"bus_bind": "0.0.0.0", "bus_host": "ow-bus",
            "controller_bind": "0.0.0.0", "controller_host": "ow-controller{i}",
@@ -328,6 +339,88 @@ def render_k8s(inv: dict, outdir: str) -> None:
     print(f"wrote {path} ({len(docs)} manifests)")
 
 
+def render_monitoring(inv: dict, outdir: str,
+                      controller_host: str = "127.0.0.1",
+                      monitoring_host: str = "127.0.0.1") -> None:
+    """Prometheus scrape config + Grafana dashboard for the deployment
+    (ref core/monitoring/user-events/compose: prometheus + the OpenWhisk
+    Grafana dashboards). Controllers expose balancer metrics on /metrics;
+    the user-events service (inventory `monitoring.enabled`) exposes the
+    per-action series. Host args take a `{i}` format for multi-host
+    topologies (e.g. "ow-controller{i}" under the k8s renderer's DNS)."""
+    os.makedirs(outdir, exist_ok=True)
+    n_ctrl = inv["controllers"]["count"]
+    base = inv["controllers"]["base_port"]
+    targets = [f"{controller_host.format(i=i)}:{base + i}"
+               for i in range(n_ctrl)]
+    scrapes = [
+        "  - job_name: openwhisk-controllers\n"
+        "    metrics_path: /metrics\n"
+        "    static_configs:\n"
+        f"      - targets: {json.dumps(targets)}\n"]
+    mon = inv.get("monitoring") or {}
+    if mon.get("enabled"):
+        scrapes.append(
+            "  - job_name: openwhisk-user-events\n"
+            "    metrics_path: /metrics\n"
+            "    static_configs:\n"
+            f"      - targets: [\"{monitoring_host}:{mon.get('port', 9096)}\"]\n")
+    prom = "global:\n  scrape_interval: 5s\nscrape_configs:\n" + "".join(scrapes)
+    path = os.path.join(outdir, "prometheus.yml")
+    with open(path, "w") as f:
+        f.write(prom)
+    print(f"wrote {path}")
+
+    def panel(pid, title, exprs, y, unit="short", width=12, x=0):
+        return {
+            "id": pid, "title": title, "type": "timeseries",
+            "gridPos": {"h": 8, "w": width, "x": x, "y": y},
+            "fieldConfig": {"defaults": {"unit": unit}},
+            "targets": [{"expr": e, "legendFormat": l, "refId": chr(65 + i)}
+                        for i, (e, l) in enumerate(exprs)],
+        }
+
+    dashboard = {
+        "title": "OpenWhisk-TPU",
+        "uid": "openwhisk-tpu",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-1h", "to": "now"},
+        "panels": [
+            panel(1, "Activations/s by action",
+                  [("sum by (action) "
+                    "(rate(openwhisk_userevents_activations_total[1m]))",
+                    "{{action}}")], 0),
+            panel(2, "Cold starts/s",
+                  [("sum(rate(openwhisk_userevents_cold_starts_total[1m]))",
+                    "cold starts")], 0, x=12),
+            panel(3, "Mean activation duration (ms)",
+                  [("sum by (action) "
+                    "(rate(openwhisk_userevents_duration_ms_sum[5m]))"
+                    " / sum by (action) "
+                    "(rate(openwhisk_userevents_duration_ms_count[5m]))",
+                    "{{action}}")], 8, unit="ms"),
+            panel(4, "Throttle rejections/s",
+                  [("sum by (namespace, metric) "
+                    "(rate(openwhisk_userevents_rate_limit_total[1m]))",
+                    "{{namespace}} {{metric}}")], 8, x=12),
+            panel(5, "Placements/s (TPU balancer)",
+                  [("rate(openwhisk_loadbalancer_tpu_scheduled[1m])",
+                    "scheduled"),
+                   ("rate(openwhisk_loadbalancer_forced_placements[1m])",
+                    "forced")], 16),
+            panel(6, "Device step mean (ms)",
+                  [("rate(openwhisk_loadbalancer_tpu_schedule_batch_ms_sum[5m])"
+                    " / rate(openwhisk_loadbalancer_tpu_schedule_batch_ms_count[5m])",
+                    "step")], 16, unit="ms", x=12),
+        ],
+    }
+    path = os.path.join(outdir, "grafana-openwhisk.json")
+    with open(path, "w") as f:
+        json.dump(dashboard, f, indent=2)
+    print(f"wrote {path}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(description="OpenWhisk-TPU deployer")
     parser.add_argument("-i", "--inventory", default=None,
@@ -337,7 +430,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("down")
     sub.add_parser("status")
     render = sub.add_parser("render")
-    render.add_argument("target", choices=("systemd", "k8s"))
+    render.add_argument("target", choices=("systemd", "k8s", "monitoring"))
     render.add_argument("-o", "--outdir", default="deploy/out")
     args = parser.parse_args(argv)
 
@@ -349,8 +442,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.cmd == "status":
         return 0 if status(inv) else 1
     elif args.cmd == "render":
-        (render_systemd if args.target == "systemd" else render_k8s)(
-            inv, args.outdir)
+        renderer = {"systemd": render_systemd, "k8s": render_k8s,
+                    "monitoring": render_monitoring}[args.target]
+        renderer(inv, args.outdir)
     return 0
 
 

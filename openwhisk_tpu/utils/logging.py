@@ -16,33 +16,43 @@ from typing import Optional
 _LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
 
 
+def _mkey(name: str, tags):
+    """Series key: (family name, sorted tag tuple) — tags are the reference
+    MetricEmitter's optional Kamon tags (Logging.scala:241-258), rendered as
+    Prometheus labels so one family fans out by e.g. action or namespace."""
+    return (name, tuple(sorted(tags.items())) if tags else ())
+
+
 class MetricEmitter:
     """Thread-safe counters / histograms / gauges (ref Logging.scala:241-258).
 
     Histograms keep (count, sum, min, max) plus a small reservoir for
     percentile estimates — enough for the /metrics endpoint and tests.
+    Every method takes optional `tags` (a flat str->str dict): tagged series
+    share the family name and differ by label set, exactly Prometheus's
+    model.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[str, int] = defaultdict(int)
-        self._gauges: dict[str, float] = {}
-        self._hist: dict[str, list] = {}  # name -> [count, sum, min, max, reservoir]
+        self._counters: dict[tuple, int] = defaultdict(int)
+        self._gauges: dict[tuple, float] = {}
+        self._hist: dict[tuple, list] = {}  # key -> [count, sum, min, max, reservoir]
 
-    def counter(self, name: str, delta: int = 1) -> None:
+    def counter(self, name: str, delta: int = 1, tags=None) -> None:
         with self._lock:
-            self._counters[name] += delta
+            self._counters[_mkey(name, tags)] += delta
 
-    def gauge(self, name: str, value: float) -> None:
+    def gauge(self, name: str, value: float, tags=None) -> None:
         with self._lock:
-            self._gauges[name] = value
+            self._gauges[_mkey(name, tags)] = value
 
-    def histogram(self, name: str, value: float) -> None:
+    def histogram(self, name: str, value: float, tags=None) -> None:
         with self._lock:
-            h = self._hist.get(name)
+            h = self._hist.get(_mkey(name, tags))
             if h is None:
                 h = [0, 0.0, float("inf"), float("-inf"), []]
-                self._hist[name] = h
+                self._hist[_mkey(name, tags)] = h
             h[0] += 1
             h[1] += value
             h[2] = min(h[2], value)
@@ -54,17 +64,17 @@ class MetricEmitter:
                 res[h[0] % 1024] = value
 
     # -- read side ---------------------------------------------------------
-    def counter_value(self, name: str) -> int:
+    def counter_value(self, name: str, tags=None) -> int:
         with self._lock:
-            return self._counters.get(name, 0)
+            return self._counters.get(_mkey(name, tags), 0)
 
-    def gauge_value(self, name: str) -> Optional[float]:
+    def gauge_value(self, name: str, tags=None) -> Optional[float]:
         with self._lock:
-            return self._gauges.get(name)
+            return self._gauges.get(_mkey(name, tags))
 
-    def histogram_stats(self, name: str) -> Optional[dict]:
+    def histogram_stats(self, name: str, tags=None) -> Optional[dict]:
         with self._lock:
-            h = self._hist.get(name)
+            h = self._hist.get(_mkey(name, tags))
             if not h or not h[0]:
                 return None
             res = sorted(h[4])
@@ -84,23 +94,46 @@ class MetricEmitter:
             }
 
     def prometheus_text(self) -> str:
-        """Render in Prometheus exposition format (ref core/monitoring)."""
+        """Render in Prometheus exposition format (ref core/monitoring):
+        one # TYPE line per family, tagged series as labels."""
         out = []
         snap = self.snapshot()
-        for k, v in sorted(snap["counters"].items()):
-            n = _prom_name(k)
-            out.append(f"# TYPE {n} counter\n{n} {v}")
-        for k, v in sorted(snap["gauges"].items()):
-            n = _prom_name(k)
-            out.append(f"# TYPE {n} gauge\n{n} {v}")
-        for k, v in sorted(snap["histograms"].items()):
-            n = _prom_name(k)
-            out.append(f"# TYPE {n} summary\n{n}_count {v['count']}\n{n}_sum {v['sum']}")
+
+        def emit(items, kind, render):
+            seen = set()
+            for key in sorted(items):
+                fam = _prom_name(key[0])
+                if fam not in seen:
+                    seen.add(fam)
+                    out.append(f"# TYPE {fam} {kind}")
+                out.append(render(_prom_series(key), items[key]))
+
+        emit(snap["counters"], "counter", lambda s_, v: f"{s_} {v}")
+        emit(snap["gauges"], "gauge", lambda s_, v: f"{s_} {v}")
+        emit(snap["histograms"], "summary",
+             lambda s_, v: _summary_lines(s_, v))
         return "\n".join(out) + "\n"
 
 
 def _prom_name(name: str) -> str:
     return "openwhisk_" + "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _prom_series(key) -> str:
+    name, tags = key
+    n = _prom_name(name)
+    if tags:
+        lbl = ",".join(f'{k}="{v}"' for k, v in tags)
+        return f"{n}{{{lbl}}}"
+    return n
+
+
+def _summary_lines(series: str, v: dict) -> str:
+    # suffix goes on the NAME, before any label block
+    if "{" in series:
+        n, lbl = series.split("{", 1)
+        return f"{n}_count{{{lbl} {v['count']}\n{n}_sum{{{lbl} {v['sum']}"
+    return f"{series}_count {v['count']}\n{series}_sum {v['sum']}"
 
 
 class Logging:

@@ -78,9 +78,12 @@ class TestUserEvents:
             return text
 
         text = run(go())
-        assert "userevents_activations_guest_hello_total 1" in text
-        assert "userevents_coldStarts_guest_hello_total 1" in text
-        assert "userevents_ConcurrentRateLimit_guest 1" in text
+        assert ('openwhisk_userevents_activations_total'
+                '{action="guest/hello"} 1') in text
+        assert ('openwhisk_userevents_cold_starts_total'
+                '{action="guest/hello"} 1') in text
+        assert ('openwhisk_userevents_rate_limit_total'
+                '{metric="ConcurrentRateLimit",namespace="guest"} 1') in text
 
 
 class TestBlacklist:

@@ -281,3 +281,76 @@ class TestLocalUp:
             deploy.down(inv)
             os.chdir(cwd)
         assert deploy._pids(inv) == []
+
+
+class TestRenderMonitoring:
+    def test_prometheus_and_grafana_render(self, tmp_path):
+        inv = deploy.load_inventory(None)
+        deploy.render_monitoring(inv, str(tmp_path))
+        prom = yaml.safe_load((tmp_path / "prometheus.yml").read_text())
+        targets = prom["scrape_configs"][0]["static_configs"][0]["targets"]
+        assert len(targets) == inv["controllers"]["count"]
+        base = inv["controllers"]["base_port"]
+        assert targets[0].endswith(str(base))
+
+        import json
+        dash = json.loads((tmp_path / "grafana-openwhisk.json").read_text())
+        assert dash["uid"] == "openwhisk-tpu"
+        exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+        assert len(exprs) >= 6
+        # every panel queries openwhisk_-prefixed series
+        assert all("openwhisk_" in e for e in exprs)
+
+    def test_monitoring_service_in_topology_and_scrape(self, tmp_path):
+        inv = deploy.load_inventory(None)
+        inv["monitoring"]["enabled"] = True
+        names = [s["name"] for s in deploy.services(inv)]
+        assert "monitoring" in names
+        deploy.render_monitoring(inv, str(tmp_path),
+                                 controller_host="ow-controller{i}",
+                                 monitoring_host="ow-monitoring")
+        prom = yaml.safe_load((tmp_path / "prometheus.yml").read_text())
+        jobs = {c["job_name"]: c for c in prom["scrape_configs"]}
+        assert jobs["openwhisk-controllers"]["static_configs"][0][
+            "targets"][0].startswith("ow-controller0:")
+        assert jobs["openwhisk-user-events"]["static_configs"][0][
+            "targets"] == [f"ow-monitoring:{inv['monitoring']['port']}"]
+
+    def test_dashboard_series_names_match_live_metrics(self):
+        """The dashboard queries must reference series the services really
+        emit — cross-check against a live recorder + balancer metric sink."""
+        import re
+
+        from openwhisk_tpu.controller.monitoring import UserEventsRecorder
+        from openwhisk_tpu.messaging.message import EventMessage
+        from openwhisk_tpu.utils.logging import MetricEmitter
+
+        metrics = MetricEmitter()
+        rec = UserEventsRecorder(None, metrics)
+        rec.record(EventMessage(
+            "controller0", {"name": "ns/act", "statusCode": 0,
+                            "duration": 12, "waitTime": 3, "initTime": 5,
+                            "memory": 256, "kind": "python:3"},
+            "subj", "ns", "uid", "Activation"))
+        rec.record(EventMessage(
+            "controller0", {"metricName": "ConcurrentRateLimit",
+                            "metricValue": 1},
+            "subj", "ns", "uid", "Metric"))
+        metrics.counter("loadbalancer_tpu_scheduled", 4)
+        metrics.counter("loadbalancer_forced_placements")
+        metrics.histogram("loadbalancer_tpu_schedule_batch_ms", 0.5)
+        live = metrics.prometheus_text()
+        live_families = set(re.findall(r"^(openwhisk_\w+)[ {]", live, re.M))
+
+        import json
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            deploy.render_monitoring(deploy.load_inventory(None), td)
+            dash = json.load(open(os.path.join(td, "grafana-openwhisk.json")))
+        exprs = " ".join(t["expr"] for p in dash["panels"]
+                         for t in p["targets"])
+        # every family referenced by a panel exists in the live exposition
+        for fam in re.findall(r"openwhisk_\w+", exprs):
+            base = re.sub(r"_(sum|count)$", "", fam)
+            assert fam in live_families or base in live_families, \
+                (fam, sorted(live_families))

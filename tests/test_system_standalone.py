@@ -519,3 +519,28 @@ class TestPlaygroundAndPreflight:
             s.bind(("127.0.0.1", PORT + 601))
             s.listen(1)
             assert preflight(PORT + 601) is False
+
+
+class TestActivationDocsParam:
+    def test_docs_true_returns_full_records(self):
+        async def go(s: aiohttp.ClientSession):
+            async with s.put(f"{BASE}/namespaces/_/actions/hello", headers=HDRS,
+                             json={"exec": {"kind": "python:3",
+                                            "code": HELLO_CODE}}):
+                pass
+            async with s.post(f"{BASE}/namespaces/_/actions/hello?blocking=true",
+                              headers=HDRS, json={"name": "Docs"}):
+                pass
+            async with s.get(f"{BASE}/namespaces/_/activations", headers=HDRS) as r:
+                summaries = await r.json()
+            async with s.get(f"{BASE}/namespaces/_/activations?docs=true",
+                             headers=HDRS) as r:
+                full = await r.json()
+            return summaries, full
+
+        summaries, full = run_system(go)
+        assert summaries and "response" not in summaries[0]
+        # ?docs=true returns the complete record (ref Activations.scala)
+        assert full and full[0]["response"]["result"] == \
+            {"greeting": "Hello Docs!"}
+        assert "logs" in full[0]
