@@ -3,15 +3,21 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
+import sys
 
 from . import GUEST_KEY, GUEST_UUID, make_standalone
 from ..utils.config import honor_jax_platforms_env
 from ..utils.tasks import wait_for_shutdown
 
 
-def preflight(port: int) -> bool:
+def preflight(port: int, manifest: dict = None,
+              manifest_path: str = None) -> bool:
     """Boot-time environment checks (ref standalone PreFlightChecks): each
-    prints one OK/FAIL line; returns False when any check fails."""
+    prints one OK/FAIL line; returns False when any check fails. `manifest`
+    is the already-parsed runtimes dict (main() reads the file exactly once
+    and hands the same dict to the server, so what preflight validated is
+    what runs)."""
     import shutil
     import socket
 
@@ -38,8 +44,21 @@ def preflight(port: int) -> bool:
           "another process is listening — pick --port")
     check("python3 for action sandboxes",
           shutil.which("python3") is not None, "python3 not on PATH")
-    ExecManifest.initialize(None)
-    print(f"  runtimes: {', '.join(ExecManifest.runtimes().kinds)}")
+    manifest_ok = True
+    if manifest is not None:
+        try:
+            ExecManifest.initialize(manifest)
+            check(f"runtimes manifest {manifest_path or '(inline)'}", True)
+        except Exception as e:  # noqa: BLE001 — ANY malformed shape is a
+            # FAIL line, not a traceback (wrong structure raises
+            # TypeError/AttributeError, not just ValueError)
+            check(f"runtimes manifest {manifest_path or '(inline)'}", False,
+                  str(e) or type(e).__name__)
+            manifest_ok = False
+    else:
+        ExecManifest.initialize(None)
+    if manifest_ok:
+        print(f"  runtimes: {', '.join(ExecManifest.runtimes().kinds)}")
     return ok
 
 
@@ -58,10 +77,26 @@ def main() -> None:
                              "(device placement kernel)")
     parser.add_argument("--no-ui", action="store_true",
                         help="do not serve the /playground dev UI")
+    parser.add_argument("--manifest", default=None,
+                        help="runtimes manifest JSON file (default: built-in "
+                             "python:3 + nodejs:14)")
     args = parser.parse_args()
 
+    # parse the manifest file exactly once; preflight and the server get
+    # the same dict (no validate/run TOCTOU window)
+    manifest = None
+    if args.manifest:
+        try:
+            with open(args.manifest) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read manifest {args.manifest}: {e}",
+                  file=sys.stderr)
+            raise SystemExit(1)
+
     print("preflight:")
-    if not preflight(args.port):
+    if not preflight(args.port, manifest=manifest,
+                     manifest_path=args.manifest):
         raise SystemExit(1)
 
     async def run():
@@ -78,7 +113,8 @@ def main() -> None:
                                                user_memory_mb=args.memory,
                                                prewarm=args.prewarm,
                                                balancer=args.balancer,
-                                               ui=not args.no_ui)
+                                               ui=not args.no_ui,
+                                               manifest=manifest)
             print(f"OpenWhisk-TPU standalone listening on :{args.port} "
                   f"(balancer={args.balancer})")
             print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")

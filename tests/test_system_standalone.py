@@ -544,3 +544,69 @@ class TestActivationDocsParam:
         assert full and full[0]["response"]["result"] == \
             {"greeting": "Hello Docs!"}
         assert "logs" in full[0]
+
+
+class TestManifestFlag:
+    def test_custom_manifest_gates_kinds(self, tmp_path):
+        import json as _json
+        import subprocess
+        import sys
+
+        from openwhisk_tpu.core.entity import ExecManifest
+
+        manifest = {"runtimes": {"python": [
+            {"kind": "python:3", "image": {"name": "action-python-v3"},
+             "default": True}]}}
+        path = tmp_path / "runtimes.json"
+        path.write_text(_json.dumps(manifest))
+        # preflight validates the parsed dict and prints its kinds
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import json; "
+             "from openwhisk_tpu.standalone.__main__ import preflight; "
+             f"m = json.load(open({str(path)!r})); import sys; "
+             f"sys.exit(0 if preflight(13987, manifest=m, "
+             f"manifest_path={str(path)!r}) else 1)"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "python:3" in out.stdout and "nodejs" not in out.stdout
+        # a structurally-wrong manifest FAILs cleanly (no traceback)
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from openwhisk_tpu.standalone.__main__ import preflight; "
+             "import sys; sys.exit(0 if preflight("
+             "13987, manifest={'runtimes': 'x'}) else 1)"],
+            capture_output=True, text=True)
+        assert out.returncode == 1, out.stdout + out.stderr
+        assert "Traceback" not in out.stderr
+        assert "[FAIL]" in out.stdout
+        # unreadable file: the CLI exits 1 before boot
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        out = subprocess.run(
+            [sys.executable, "-m", "openwhisk_tpu.standalone",
+             "--manifest", str(bad), "--port", "13989"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 1
+        assert "cannot read manifest" in out.stderr
+
+        # the server built from the manifest rejects unknown kinds
+        async def go():
+            controller = await make_standalone(port=13988, manifest=manifest)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.put(
+                            "http://127.0.0.1:13988/api/v1/namespaces/_/actions/njs",
+                            headers=HDRS,
+                            json={"exec": {"kind": "nodejs:14",
+                                           "code": "x"}}) as r:
+                        return r.status, await r.json()
+            finally:
+                await controller.stop()
+
+        try:
+            status, body = asyncio.run(go())
+        finally:
+            ExecManifest.initialize(None)  # restore the process singleton
+        assert status == 400
+        assert "nodejs:14" in body["error"]
