@@ -22,7 +22,8 @@ from aiohttp import web
 
 from ..core.entity import (ACTIVE, ActivationId, Binding, EntityName,
                            EntityPath, Exec, ExecManifest, Identity,
-                           LimitViolation, MemoryLimit, Parameters,
+                           LimitViolation, MalformedEntity, MemoryLimit,
+                           Parameters,
                            ReducedRule, SequenceExec, TimeLimit, WhiskAction,
                            WhiskActivation, WhiskPackage, WhiskRule,
                            WhiskTrigger)
@@ -141,6 +142,10 @@ class ControllerApi:
         request["transid"] = TransactionId()
         try:
             return await handler(request)
+        except MalformedEntity as e:
+            # wrong-typed entity JSON: the reference's 400, never a 500
+            return _error(400, f"The request content was malformed ({e}).",
+                          request.get("transid"))
         except EntitlementException as e:
             return _error(e.status, e.message, request.get("transid"))
         except NoDocumentException:
@@ -453,6 +458,8 @@ class ControllerApi:
         if "exec" in body:
             try:
                 exec_ = Exec.from_json(body["exec"])
+            except MalformedEntity:
+                raise  # the middleware answers the reference's malformed-400
             except ValueError as e:
                 # e.g. an unparsable component FQN in a sequence
                 return _error(400, f"malformed exec: {e}", request["transid"])

@@ -6,7 +6,7 @@ from .ids import (ActivationId, BasicAuthenticationAuthKey, ControllerInstanceId
                   Subject, UUID)
 from .names import (DEFAULT_NAMESPACE, EntityName, EntityPath,
                     FullyQualifiedEntityName)
-from .parameters import Parameters, ParameterValue
+from .parameters import MalformedEntity, Parameters, ParameterValue
 from .limits import (ActionLimits, ConcurrencyLimit, LimitViolation, LogLimit,
                      MemoryLimit, TimeLimit)
 from .exec import (BLACKBOX_KIND, SEQUENCE_KIND, BlackBoxExec, CodeExec, Exec,

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from .names import FullyQualifiedEntityName
+from .parameters import MalformedEntity
 
 SEQUENCE_KIND = "sequence"
 BLACKBOX_KIND = "blackbox"
@@ -32,7 +33,11 @@ class Exec:
 
     @staticmethod
     def from_json(j: dict) -> "Exec":
+        if j is not None and not isinstance(j, dict):
+            raise MalformedEntity("exec must be an object")
         kind = (j or {}).get("kind", "")
+        if not isinstance(kind, str):
+            raise MalformedEntity("exec kind must be a string")
         if kind == SEQUENCE_KIND:
             return SequenceExec.from_json(j)
         if kind == BLACKBOX_KIND:
@@ -94,6 +99,8 @@ class BlackBoxExec(Exec):
 
     @classmethod
     def from_json(cls, j: dict) -> "BlackBoxExec":
+        if not isinstance(j.get("image"), str):
+            raise MalformedEntity("blackbox exec needs a string image")
         return cls(image=j["image"], code=j.get("code"), main=j.get("main"),
                    binary=bool(j.get("binary", False)))
 
@@ -111,7 +118,12 @@ class SequenceExec(Exec):
 
     @classmethod
     def from_json(cls, j: dict) -> "SequenceExec":
-        return cls(components=[FullyQualifiedEntityName.parse(c) for c in j.get("components", [])])
+        comps = j.get("components", [])
+        if not isinstance(comps, list) or \
+                not all(isinstance(c, str) for c in comps):
+            raise MalformedEntity(
+                "sequence components must be a list of action names")
+        return cls(components=[FullyQualifiedEntityName.parse(c) for c in comps])
 
 
 # ---------------------------------------------------------------------------

@@ -12,7 +12,23 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from .parameters import MalformedEntity
 from .size import MB, ByteSize
+
+
+def _int_value(j, what: str) -> int:
+    """Limits are integers on the wire; anything else — booleans, non-integral
+    or non-finite floats, unparsable strings — is a malformed body, not a
+    server error (and not a silent truncation)."""
+    if isinstance(j, bool) or not isinstance(j, (int, float, str)):
+        raise MalformedEntity(f"{what} limit must be an integer")
+    try:
+        n = int(j)
+    except (TypeError, ValueError, OverflowError):
+        raise MalformedEntity(f"{what} limit must be an integer") from None
+    if isinstance(j, float) and j != n:
+        raise MalformedEntity(f"{what} limit must be an integer")
+    return n
 
 
 class LimitViolation(ValueError):
@@ -43,7 +59,7 @@ class MemoryLimit:
 
     @classmethod
     def from_json(cls, j) -> "MemoryLimit":
-        return cls(MB(int(j)))
+        return cls(MB(_int_value(j, "memory")))
 
     def __eq__(self, other):
         return isinstance(other, MemoryLimit) and self.megabytes == other.megabytes
@@ -76,7 +92,7 @@ class TimeLimit:
 
     @classmethod
     def from_json(cls, j) -> "TimeLimit":
-        return cls(int(j))
+        return cls(_int_value(j, "timeout"))
 
     def __eq__(self, other):
         return isinstance(other, TimeLimit) and self.millis == other.millis
@@ -107,7 +123,7 @@ class LogLimit:
 
     @classmethod
     def from_json(cls, j) -> "LogLimit":
-        return cls(MB(int(j)))
+        return cls(MB(_int_value(j, "logs")))
 
     def __eq__(self, other):
         return isinstance(other, LogLimit) and self.megabytes == other.megabytes
@@ -139,7 +155,7 @@ class ConcurrencyLimit:
 
     @classmethod
     def from_json(cls, j) -> "ConcurrencyLimit":
-        return cls(int(j))
+        return cls(_int_value(j, "concurrency"))
 
     def __eq__(self, other):
         return isinstance(other, ConcurrencyLimit) and self.max_concurrent == other.max_concurrent
@@ -168,6 +184,8 @@ class ActionLimits:
 
     @classmethod
     def from_json(cls, j) -> "ActionLimits":
+        if j is not None and not isinstance(j, dict):
+            raise MalformedEntity("limits must be an object")
         j = j or {}
         return cls(
             TimeLimit.from_json(j["timeout"]) if "timeout" in j else None,

@@ -12,6 +12,12 @@ import json
 from typing import Any, Dict, Iterable, Optional
 
 
+class MalformedEntity(ValueError):
+    """Wrong-typed JSON in an entity body. The REST layer maps this to the
+    reference's 400 "The request content was malformed" instead of letting
+    a TypeError/AttributeError surface as a 500."""
+
+
 class ParameterValue:
     __slots__ = ("value", "init")
 
@@ -111,8 +117,14 @@ class Parameters:
             return cls()
         if isinstance(j, dict):  # accept {k: v} shorthand
             return cls.from_arguments(j)
+        if not isinstance(j, list):
+            raise MalformedEntity(
+                "parameters/annotations must be a [{key, value}] list")
         params: Dict[str, ParameterValue] = {}
         for item in j:
+            if not isinstance(item, dict) or not isinstance(item.get("key"), str):
+                raise MalformedEntity(
+                    "parameters/annotations entries need a string 'key'")
             params[item["key"]] = ParameterValue(item.get("value"), bool(item.get("init", False)))
         return cls(params)
 

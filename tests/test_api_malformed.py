@@ -1,0 +1,130 @@
+"""Malformed-body robustness across the entity PUT surface: wrong-typed
+JSON must answer the reference's 400 "The request content was malformed"
+(ErrorResponse semantics), never an unhandled 500. The parsers raise
+MalformedEntity (core/entity/parameters.py) and the auth middleware maps
+it once for every route."""
+import asyncio
+import base64
+
+import aiohttp
+import pytest
+
+from openwhisk_tpu.core.entity import (ActionLimits, Exec, MalformedEntity,
+                                       Parameters)
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID, make_standalone
+
+AUTH = "Basic " + base64.b64encode(f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+PORT = 13245
+BASE = f"http://127.0.0.1:{PORT}/api/v1"
+
+BAD_BODIES = [
+    {"annotations": "notalist"},
+    {"annotations": [{"novalue": 1}]},
+    {"annotations": [{"key": 7}]},
+    {"parameters": [["k", "v"]]},
+    {"limits": "notadict"},
+    {"limits": {"timeout": "soon"}},
+    {"limits": {"memory": []}},
+    {"limits": {"memory": True}},
+    {"limits": {"concurrency": {"max": 2}}},
+    {"exec": "notadict"},
+    {"exec": {"kind": []}},
+    {"exec": {"kind": "blackbox"}},
+    {"exec": {"kind": "sequence", "components": "notalist"}},
+    {"exec": {"kind": "sequence", "components": [123]}},
+]
+
+
+class TestParsersRejectWrongTypes:
+    def test_parameters(self):
+        for bad in ("notalist", [["k", "v"]], [{"novalue": 1}], [{"key": 7}]):
+            with pytest.raises(MalformedEntity):
+                Parameters.from_json(bad)
+        # None, {k: v} shorthand and the wire list stay accepted
+        assert len(Parameters.from_json(None)) == 0
+        assert Parameters.from_json({"a": 1}).get("a") == 1
+        assert Parameters.from_json([{"key": "a", "value": 2}]).get("a") == 2
+
+    def test_limits(self):
+        for bad in ("notadict", 7):
+            with pytest.raises(MalformedEntity):
+                ActionLimits.from_json(bad)
+        for bad in ({"timeout": "soon"}, {"memory": []}, {"memory": True},
+                    {"logs": {}}, {"concurrency": {"max": 2}}):
+            with pytest.raises(MalformedEntity):
+                ActionLimits.from_json(bad)
+        assert ActionLimits.from_json({"timeout": 60000}).timeout.millis == 60000
+        assert ActionLimits.from_json({"memory": "256"}).memory.megabytes == 256
+
+    def test_exec(self):
+        for bad in ("notadict", {"kind": []}, {"kind": "blackbox"},
+                    {"kind": "sequence", "components": "notalist"},
+                    {"kind": "sequence", "components": [123]}):
+            with pytest.raises(MalformedEntity):
+                Exec.from_json(bad)
+
+
+class TestRestSurfaceNever500s:
+    def test_entity_puts_with_malformed_bodies(self):
+        async def go():
+            controller = await make_standalone(port=PORT)
+            statuses = []
+            try:
+                async with aiohttp.ClientSession() as s:
+                    for kind in ("actions", "triggers", "rules", "packages"):
+                        for i, body in enumerate(BAD_BODIES):
+                            b = dict(body)
+                            if kind == "actions" and "exec" not in b:
+                                b["exec"] = {"kind": "python:3", "code": "x"}
+                            if kind == "rules":
+                                b.setdefault("trigger", "/_/t")
+                                b.setdefault("action", "/_/a")
+                            async with s.put(
+                                    f"{BASE}/namespaces/_/{kind}/f{i}",
+                                    headers=HDRS, json=b) as r:
+                                statuses.append(
+                                    (kind, body, r.status, await r.json()))
+            finally:
+                await controller.stop()
+            return statuses
+
+        for kind, body, status, resp in asyncio.run(go()):
+            # the invariant is NO 500s; a 200 is legitimate when the entity
+            # type simply has no such field (e.g. trigger `limits`)
+            assert status < 500, (kind, body, status, resp)
+            if kind == "actions":
+                assert 400 <= status, (kind, body, status, resp)
+        # the malformed ones carry the reference's message
+        async def probe():
+            controller = await make_standalone(port=PORT)
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.put(f"{BASE}/namespaces/_/actions/m",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": "x"},
+                                           "annotations": "notalist"}) as r:
+                        return r.status, await r.json()
+            finally:
+                await controller.stop()
+
+        status, body = asyncio.run(probe())
+        assert status == 400
+        assert body["error"].startswith("The request content was malformed")
+
+
+class TestLimitEdgeValues:
+    def test_infinite_and_fractional_limits_rejected(self):
+        for bad in ({"timeout": 1e999}, {"timeout": float("inf")},
+                    {"memory": 256.9}, {"timeout": 59999.9}):
+            with pytest.raises(MalformedEntity):
+                ActionLimits.from_json(bad)
+        # integral floats remain accepted (JSON numbers)
+        assert ActionLimits.from_json({"memory": 256.0}).memory.megabytes == 256
+
+    def test_falsy_wrong_types_rejected(self):
+        for bad in ([], "", 0, False):
+            with pytest.raises(MalformedEntity):
+                ActionLimits.from_json(bad)
+        assert ActionLimits.from_json(None) is not None
