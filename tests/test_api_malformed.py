@@ -128,3 +128,53 @@ class TestLimitEdgeValues:
             with pytest.raises(MalformedEntity):
                 ActionLimits.from_json(bad)
         assert ActionLimits.from_json(None) is not None
+
+
+class TestWebAndQuerySurfacesNever500:
+    CASES = [
+        ("GET", "/api/v1/web/guest/default/w.bogus", None, False),
+        ("GET", "/api/v1/web/guest/default/w.json/deep/proj", None, False),
+        ("POST", "/api/v1/web/guest/default/w.json", b"{bad", False),
+        ("POST", "/api/v1/web/guest/default/w.json", b"\xff\xfe", False),
+        ("GET", "/api/v1/web/guest/nopkg/nosuch.json", None, False),
+        ("GET", "/api/v1/namespaces/_/activations?limit=abc", None, True),
+        ("GET", "/api/v1/namespaces/_/activations?since=abc", None, True),
+        ("GET", "/api/v1/namespaces/_/activations?upto=zzz&skip=-5", None, True),
+        ("GET", "/api/v1/namespaces/_/activations/notanid", None, True),
+        ("GET", "/api/v1/namespaces/_/actions?limit=99999999999999999999",
+         None, True),
+        ("POST", "/api/v1/namespaces/_/actions/w?timeout=nope&blocking=true",
+         b"{}", True),
+        ("GET", "/api/v1/namespaces/%2e%2e/actions", None, True),
+        ("PUT", "/api/v1/namespaces/_/apis", b"{bad", True),
+        ("POST", "/api/v1/namespaces/_/apis", b'{"x": 1}', True),
+    ]
+
+    def test_web_and_query_fuzz(self):
+        root = f"http://127.0.0.1:{PORT}"
+
+        async def go():
+            controller = await make_standalone(port=PORT)
+            out = []
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.put(f"{BASE}/namespaces/_/actions/w",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": "def main(a):\n"
+                                                            "    return {'k': 1}"},
+                                           "annotations": [
+                                               {"key": "web-export",
+                                                "value": True}]}):
+                        pass
+                    for method, path, data, authed in self.CASES:
+                        hdrs = HDRS if authed else None
+                        async with s.request(method, root + path, data=data,
+                                             headers=hdrs) as r:
+                            out.append((method, path, r.status))
+            finally:
+                await controller.stop()
+            return out
+
+        for method, path, status in asyncio.run(go()):
+            assert status < 500, (method, path, status)
