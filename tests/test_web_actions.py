@@ -1,0 +1,206 @@
+"""Web-action semantics (ref WebActions.scala:375-576 + WebActionsApiTests):
+extension-driven content negotiation, .http full-control responses, the
+__ow_* request context, raw-http mode, require-whisk-auth, and the 404/401
+surfaces. Driven over real HTTP against the standalone server."""
+import asyncio
+import base64
+
+import aiohttp
+
+from openwhisk_tpu.standalone import GUEST_KEY, GUEST_UUID, make_standalone
+
+AUTH = "Basic " + base64.b64encode(f"{GUEST_UUID}:{GUEST_KEY}".encode()).decode()
+HDRS = {"Authorization": AUTH, "Content-Type": "application/json"}
+PORT = 13247
+API = f"http://127.0.0.1:{PORT}/api/v1"
+WEB = f"http://127.0.0.1:{PORT}/api/v1/web/guest/default"
+
+ECHO = """
+def main(args):
+    return {'echo': {k: v for k, v in args.items()}}
+"""
+
+HTTPCTL = """
+import base64
+def main(args):
+    body = args.get('wantbody', 'hello <b>web</b>')
+    out = {'statusCode': int(args.get('code', 201)),
+           'headers': {'X-Marker': 'yes'},
+           'body': body}
+    if args.get('png'):
+        out['headers'] = {'Content-Type': 'image/png'}
+        out['body'] = base64.b64encode(b'\\x89PNG fake').decode()
+    return out
+"""
+
+FIELDS = """
+def main(args):
+    return {'text': 'plain-value', 'html': '<h1>hi</h1>',
+            'svg': '<svg/>', 'error': None}
+"""
+
+
+def run_web(coro_fn):
+    async def serve():
+        controller = await make_standalone(port=PORT)
+        try:
+            async with aiohttp.ClientSession() as session:
+                return await coro_fn(session)
+        finally:
+            await controller.stop()
+    return asyncio.run(serve())
+
+
+async def _mk(s, name, code, annotations=None):
+    ann = [{"key": "web-export", "value": True}] + (annotations or [])
+    async with s.put(f"{API}/namespaces/_/actions/{name}", headers=HDRS,
+                     json={"exec": {"kind": "python:3", "code": code},
+                           "annotations": ann}) as r:
+        assert r.status == 200, await r.text()
+
+
+class TestHttpExtension:
+    def test_full_control_status_headers_body(self):
+        async def go(s):
+            await _mk(s, "ctl", HTTPCTL)
+            async with s.get(f"{WEB}/ctl.http") as r:
+                return r.status, r.headers.get("X-Marker"), await r.text(), \
+                    r.headers.get("Content-Type", "")
+        status, marker, text, ct = run_web(go)
+        assert status == 201
+        assert marker == "yes"
+        assert text == "hello <b>web</b>"
+        assert ct.startswith("text/html")
+
+    def test_extensionless_defaults_to_http(self):
+        async def go(s):
+            await _mk(s, "ctl", HTTPCTL)
+            async with s.get(f"{WEB}/ctl") as r:
+                return r.status, r.headers.get("X-Marker")
+        status, marker = run_web(go)
+        assert status == 201 and marker == "yes"
+
+    def test_base64_binary_body(self):
+        async def go(s):
+            await _mk(s, "ctl", HTTPCTL)
+            async with s.get(f"{WEB}/ctl.http?png=1") as r:
+                return r.status, r.headers.get("Content-Type"), await r.read()
+        status, ct, body = run_web(go)
+        assert status == 201
+        assert ct == "image/png"
+        assert body == b"\x89PNG fake"
+
+    def test_error_results_pass_through_on_http(self):
+        # .http gives the action full control even for error-shaped results
+        async def go(s):
+            await _mk(s, "ctl", HTTPCTL)
+            async with s.get(f"{WEB}/ctl.http?code=418") as r:
+                return r.status
+        assert run_web(go) == 418
+
+
+class TestFieldExtensions:
+    def test_text_html_svg_and_json(self):
+        async def go(s):
+            await _mk(s, "fields", FIELDS)
+            out = {}
+            for ext in ("text", "html", "svg", "json"):
+                async with s.get(f"{WEB}/fields.{ext}") as r:
+                    out[ext] = (r.status, r.headers.get("Content-Type", ""),
+                                await r.text())
+            return out
+        out = run_web(go)
+        assert out["text"][1].startswith("text/plain")
+        assert out["text"][2] == "plain-value"
+        assert out["html"][1].startswith("text/html")
+        assert out["html"][2] == "<h1>hi</h1>"
+        assert out["svg"][1].startswith("image/svg+xml")
+        assert out["json"][1].startswith("application/json")
+        assert "plain-value" in out["json"][2]
+
+
+class TestRequestContext:
+    def test_ow_fields_and_query_merge(self):
+        async def go(s):
+            await _mk(s, "echo", ECHO)
+            async with s.post(f"{WEB}/echo.json?who=q",
+                              headers={"X-My-Header": "present",
+                                       "Content-Type": "application/json"},
+                              json={"who_body": "b"}) as r:
+                return (await r.json())["echo"]
+        echo = run_web(go)
+        assert echo["__ow_method"] == "post"
+        assert echo["who"] == "q"
+        assert echo["who_body"] == "b"
+        assert echo["__ow_headers"].get("X-My-Header") == "present"
+
+    def test_raw_http_mode(self):
+        async def go(s):
+            await _mk(s, "raw", ECHO,
+                      annotations=[{"key": "raw-http", "value": True}])
+            async with s.post(f"{WEB}/raw.json?a=1&b=2",
+                              data=b'{"not": "merged"}') as r:
+                return (await r.json())["echo"]
+        echo = run_web(go)
+        # raw mode: body arrives base64'd, the query string unparsed
+        assert base64.b64decode(echo["__ow_body"]) == b'{"not": "merged"}'
+        assert echo["__ow_query"] == "a=1&b=2"
+        assert "not" not in echo
+
+
+class TestAuthSurfaces:
+    def test_require_whisk_auth_secret(self):
+        async def go(s):
+            await _mk(s, "sec", ECHO,
+                      annotations=[{"key": "require-whisk-auth",
+                                    "value": "s3cret"}])
+            out = {}
+            async with s.get(f"{WEB}/sec.json") as r:
+                out["missing"] = r.status
+            async with s.get(f"{WEB}/sec.json",
+                             headers={"X-Require-Whisk-Auth": "wrong"}) as r:
+                out["wrong"] = r.status
+            async with s.get(f"{WEB}/sec.json",
+                             headers={"X-Require-Whisk-Auth": "s3cret"}) as r:
+                out["right"] = r.status
+            return out
+        out = run_web(go)
+        assert out["missing"] == 401 and out["wrong"] == 401
+        assert out["right"] == 200
+
+    def test_require_platform_auth(self):
+        async def go(s):
+            await _mk(s, "plat", ECHO,
+                      annotations=[{"key": "require-whisk-auth",
+                                    "value": True}])
+            out = {}
+            async with s.get(f"{WEB}/plat.json") as r:
+                out["anon"] = r.status
+            async with s.get(f"{WEB}/plat.json",
+                             headers={"Authorization": AUTH}) as r:
+                out["authed"] = r.status
+            return out
+        out = run_web(go)
+        assert out["anon"] == 401 and out["authed"] == 200
+
+    def test_non_exported_action_404s(self):
+        async def go(s):
+            async with s.put(f"{API}/namespaces/_/actions/private",
+                             headers=HDRS,
+                             json={"exec": {"kind": "python:3",
+                                            "code": ECHO}}) as r:
+                assert r.status == 200
+            async with s.get(f"{WEB}/private.json") as r:
+                return r.status
+        assert run_web(go) == 404
+
+    def test_error_result_is_502_with_activation_id(self):
+        async def go(s):
+            await _mk(s, "boom",
+                      "def main(a):\n    return {'error': 'deliberate'}\n")
+            async with s.get(f"{WEB}/boom.json") as r:
+                return r.status, await r.json()
+        status, body = run_web(go)
+        assert status == 502
+        assert body["error"] == "deliberate"
+        assert "activationId" in body
