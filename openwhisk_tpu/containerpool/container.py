@@ -71,14 +71,24 @@ class Container:
     # -- HTTP contract -----------------------------------------------------
     def _http(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
+            # force_close: one fresh connection per request. Keep-alive
+            # reuse races the container closing idle sockets (e.g. around
+            # pause/resume) and surfaces as ServerDisconnectedError on /run
+            # — which must NOT be retried (at-most-once for user code), so
+            # the stale-socket case is removed structurally instead
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(force_close=True))
         return self._session
 
-    async def _post(self, path: str, payload: dict, timeout: float
-                    ) -> Tuple[int, dict]:
+    async def _post(self, path: str, payload: dict, timeout: float,
+                    retry_disconnects: bool = False) -> Tuple[int, dict]:
         """POST with connect retries: a cold container's server may not be
         listening yet (the reference's HttpUtils retries until the socket
-        opens, bounded only by the caller's timeout)."""
+        opens, bounded only by the caller's timeout). `retry_disconnects`
+        additionally retries ServerDisconnectedError — only /init opts in
+        (idempotent; ref Container.scala:123 retry=true vs :168 run
+        retry=false, NoHttpResponseException handling in
+        ApacheBlockingContainerClient.scala:160-163)."""
         url = f"http://{self.addr[0]}:{self.addr[1]}{path}"
         last: Optional[Exception] = None
         deadline = time.monotonic() + timeout
@@ -98,6 +108,13 @@ class Container:
             except (aiohttp.ClientConnectorError, ConnectionRefusedError) as e:
                 last = e
                 await asyncio.sleep(0.05)
+            except aiohttp.ServerDisconnectedError as e:
+                if not retry_disconnects:
+                    raise ContainerError(
+                        f"connection to container {self.container_id} "
+                        f"failed: {e!r}") from e
+                last = e
+                await asyncio.sleep(0.05)
             except asyncio.TimeoutError:
                 return 408, {"error": f"request to {path} timed out"}
             except (aiohttp.ClientError, OSError) as e:
@@ -110,7 +127,8 @@ class Container:
         """POST /init; returns init duration in ms. Raises
         InitializationError on non-OK (ref Container.initialize:113-150)."""
         t0 = time.monotonic()
-        status, body = await self._post("/init", {"value": init_payload}, timeout)
+        status, body = await self._post("/init", {"value": init_payload},
+                                        timeout, retry_disconnects=True)
         dt = int((time.monotonic() - t0) * 1000)
         if status == 408:
             raise InitializationError(

@@ -135,6 +135,13 @@ class Client:
             body = await r.json() if r.content_type == "application/json" else {}
             return r.status, body
 
+    async def put(self, path: str, payload: Optional[dict] = None) -> tuple:
+        async with self.session.put(f"{self.base}{path}",
+                                    headers=self.headers,
+                                    json=payload or {}) as r:
+            body = await r.json() if r.content_type == "application/json" else {}
+            return r.status, body
+
     async def delete(self, path: str) -> int:
         async with self.session.delete(f"{self.base}{path}",
                                        headers=self.headers) as r:
@@ -169,13 +176,16 @@ async def timed_loop(n_requests: int, concurrency: int,
     return Stats("", samples, wall, errors)
 
 
-def run_with_standalone(coro_fn, port: int = 13366, **standalone_kw):
+def run_with_standalone(coro_fn, port: int = 13366, pass_controller: bool = False,
+                        **standalone_kw):
     """Boot the standalone server, run coro_fn(client), tear down.
 
     Throttles are raised far past what any simulation drives (the reference
     perf setups do the same in their deployment config,
     tests/performance/README.md) — the harness measures the data plane, not
-    the 60/min namespace rate limit; ThrottleTests cover enforcement."""
+    the 60/min namespace rate limit; ThrottleTests cover enforcement.
+    `pass_controller=True` calls coro_fn(client, controller) for simulations
+    that inspect the balancer's books (soak)."""
     from openwhisk_tpu.standalone import (GUEST_KEY, GUEST_UUID,
                                           make_standalone)
 
@@ -189,6 +199,8 @@ def run_with_standalone(coro_fn, port: int = 13366, **standalone_kw):
             async with aiohttp.ClientSession() as session:
                 client = Client(session, f"http://127.0.0.1:{port}/api/v1",
                                 GUEST_UUID, GUEST_KEY)
+                if pass_controller:
+                    return await coro_fn(client, controller)
                 return await coro_fn(client)
         finally:
             await controller.stop()

@@ -44,6 +44,20 @@ class TestSimulations:
                  capsys.readouterr().out.strip().splitlines()]
         assert ok and [l["errors"] for l in lines] == [0, 0]
 
+    def test_soak_smoke_asserts_clean_books(self, capsys):
+        """3s soak over the TPU balancer: mixed load, then zero leaked
+        activation slots / concurrency refcounts (the assertions live
+        inside soak_simulation)."""
+        ok = simulations.run_soak(duration=3.0, concurrency=4, port=13444)
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert ok
+        books = next(l["soak_books"] for l in lines if "soak_books" in l)
+        assert books["active_activations"] == 0
+        assert books["conc_refcounts"] == 0
+        stats = next(l for l in lines if l.get("simulation") == "soak")
+        assert stats["errors"] == 0 and stats["requests"] > 0
+
 
 class TestPlacementSweep:
     def test_single_and_sharded_rows(self):
