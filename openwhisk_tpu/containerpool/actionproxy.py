@@ -240,6 +240,15 @@ def main() -> None:
             resource.setrlimit(resource.RLIMIT_AS, (int(limit), int(limit)))
         except (ValueError, OSError, ImportError):
             pass
+    # per-connection handler threads get 4 MB stacks instead of the ~8 MB
+    # default: the virtual stack counts against RLIMIT_AS (the sandbox
+    # memory cap limits ADDRESS SPACE), and bursts of fresh connections
+    # were exhausting it and wedging the accept loop. Not smaller: the
+    # handler thread IS the user-code execution context, and C-stack-heavy
+    # actions (deep json/re/pickle recursion) must raise catchable errors,
+    # not overflow the thread stack
+    import threading
+    threading.stack_size(4 * 1024 * 1024)
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 8080
     # optional bind host: a container runtime hands each sandbox its own
     # address (e.g. per-container loopback IPs); default matches the

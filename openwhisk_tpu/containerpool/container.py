@@ -36,6 +36,10 @@ class RunResult:
     response: Optional[Dict[str, Any]]
     ok: bool
     timed_out: bool = False
+    #: transport-level failure (socket died mid-request): the container's
+    #: state is unknown, the proxy must treat it as a whisk error and
+    #: destroy — NOT as the user code's own error
+    connection_failed: bool = False
 
     @property
     def interval_ms(self) -> int:
@@ -147,7 +151,8 @@ class Container:
         try:
             status, body = await self._post("/run", payload, timeout)
         except ContainerError as e:
-            return RunResult(start, time.time(), {"error": str(e)}, ok=False)
+            return RunResult(start, time.time(), {"error": str(e)}, ok=False,
+                             connection_failed=True)
         end = time.time()
         if status == 408:
             return RunResult(start, end,

@@ -371,17 +371,21 @@ def _response_from_run(result: RunResult) -> ActivationResponse:
     if result.timed_out:
         return ActivationResponse.developer_error(
             body.get("error", "action exceeded its allotted time"))
+    if result.connection_failed:
+        # the socket to the container died mid-request: whisk error, so the
+        # proxy destroys the (state-unknown) container instead of letting a
+        # wedged sandbox fail every subsequent warm invoke (ref Container
+        # connection failures -> destroy + error activation)
+        return ActivationResponse.whisk_error(
+            body.get("error", "connection to the action container failed"))
     if result.ok:
         if isinstance(body, dict) and set(body.keys()) == {"error"}:
             return ActivationResponse.application_error(body["error"])
         return ActivationResponse.success(body)
     if isinstance(body, dict) and "error" in body:
         err = body["error"]
-        if isinstance(err, str) and err.startswith("An error has occurred"):
-            return ActivationResponse.application_error(err)
-        if isinstance(err, str) and (err.startswith("cannot connect") or
-                                     "failed to start" in err):
-            return ActivationResponse.whisk_error(err)
+        # transport failures never reach here (connection_failed above);
+        # a body with "error" is the action proxy's own HTTP response
         return ActivationResponse.application_error(err)
     return ActivationResponse.developer_error(
         "the action did not produce a valid response")

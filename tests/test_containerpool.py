@@ -432,3 +432,41 @@ class TestProcessContainer:
         final = rec.stored[0]
         assert final.response.is_app_error
         assert any("ValueError" in l for l in final.logs)
+
+
+class TestConnectionFailureHandling:
+    def test_run_connection_failure_is_whisk_error_and_destroys(self):
+        """A transport-level /run failure must produce a whisk error and
+        destroy the container — a wedged sandbox must not keep serving
+        failures to every subsequent warm invoke."""
+        class DisconnectingContainer(StubContainer):
+            async def run(self, args, environment, timeout=60.0):
+                from openwhisk_tpu.containerpool.container import RunResult
+                t = time.time()
+                return RunResult(t, t, {"error": "connection to container "
+                                                 "stub failed: boom"},
+                                 ok=False, connection_failed=True)
+
+        class F:
+            def __init__(self):
+                self.created = []
+
+            async def create_container(self, transid, name, image, memory,
+                                       cpu_shares=0, action=None):
+                c = DisconnectingContainer(cid=f"dc-{len(self.created)}")
+                self.created.append(c)
+                return c
+
+        async def go():
+            factory = F()
+            rec = AckRecorder()
+            proxy = make_proxy(factory, rec)
+            action = make_action()
+            await proxy.run(action, make_msg(action))
+            await asyncio.wait_for(rec.event.wait(), 5)
+            return rec.stored[0], factory.created[0]
+
+        activation, container = asyncio.run(go())
+        assert activation.response.is_whisk_error
+        assert container.destroyed, \
+            "state-unknown container must be destroyed, not reused"
