@@ -48,16 +48,24 @@ async def _wsk(*argv) -> int:
         wsk.main, ["--apihost", HOST, "--auth", AUTH_PAIR, *argv])
 
 
-async def _feed_activation_results(s, name):
-    async with s.get(f"{BASE}/namespaces/_/activations",
-                     headers=HDRS, params={"name": name}) as r:
-        summaries = await r.json()
+async def _feed_activation_results(s, name, expect=1):
+    """Record writes are asynchronous (blocking acks race the store, as in
+    the reference) — poll until `expect` records are visible."""
     results = []
-    for summary in summaries:
-        aid = summary["activationId"]
-        async with s.get(f"{BASE}/namespaces/_/activations/{aid}/result",
-                         headers=HDRS) as r:
-            results.append((await r.json()).get("result"))
+    for _ in range(40):
+        async with s.get(f"{BASE}/namespaces/_/activations",
+                         headers=HDRS, params={"name": name}) as r:
+            summaries = await r.json()
+        if len(summaries) >= expect:
+            results = []
+            for summary in summaries:
+                aid = summary["activationId"]
+                async with s.get(
+                        f"{BASE}/namespaces/_/activations/{aid}/result",
+                        headers=HDRS) as r:
+                    results.append((await r.json()).get("result"))
+            break
+        await asyncio.sleep(0.25)
     return results
 
 
@@ -105,9 +113,11 @@ class TestFeedLifecycle:
             async with s.get(f"{BASE}/namespaces/_/triggers/t1",
                              headers=HDRS) as r:
                 trig = (r.status, await r.json())
-            after_create = await _feed_activation_results(s, "feedact")
+            after_create = await _feed_activation_results(s, "feedact",
+                                                           expect=1)
             rc_delete = await _wsk("trigger", "delete", "t1")
-            after_delete = await _feed_activation_results(s, "feedact")
+            after_delete = await _feed_activation_results(s, "feedact",
+                                                           expect=2)
             async with s.get(f"{BASE}/namespaces/_/triggers/t1",
                              headers=HDRS) as r:
                 gone = r.status
