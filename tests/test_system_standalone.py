@@ -81,12 +81,18 @@ class TestStandaloneSystem:
             async with s.post(f"{BASE}/namespaces/_/actions/hello",
                               headers=HDRS, json={}) as r:
                 out["nonblocking"] = (r.status, "activationId" in await r.json())
-            await asyncio.sleep(0.3)
-            # activation record + logs
+            # activation record + logs (the ack races the async record
+            # write: poll the by-id GET until the record lands)
             aid = out["invoke"][3]
-            async with s.get(f"{BASE}/namespaces/_/activations/{aid}", headers=HDRS) as r:
-                act = await r.json()
-                out["activation"] = (act["response"]["result"], act["logs"])
+            for _ in range(40):
+                async with s.get(f"{BASE}/namespaces/_/activations/{aid}",
+                                 headers=HDRS) as r:
+                    if r.status == 200:
+                        act = await r.json()
+                        out["activation"] = (act["response"]["result"],
+                                             act["logs"])
+                        break
+                await asyncio.sleep(0.25)
             async with s.get(f"{BASE}/namespaces/_/activations/{aid}/logs",
                              headers=HDRS) as r:
                 out["logs"] = (await r.json())["logs"]
@@ -531,8 +537,15 @@ class TestActivationDocsParam:
             async with s.post(f"{BASE}/namespaces/_/actions/hello?blocking=true",
                               headers=HDRS, json={"name": "Docs"}):
                 pass
-            async with s.get(f"{BASE}/namespaces/_/activations", headers=HDRS) as r:
-                summaries = await r.json()
+            # the blocking ack races the asynchronous record write: poll
+            summaries = []
+            for _ in range(40):
+                async with s.get(f"{BASE}/namespaces/_/activations",
+                                 headers=HDRS) as r:
+                    summaries = await r.json()
+                if summaries:
+                    break
+                await asyncio.sleep(0.25)
             async with s.get(f"{BASE}/namespaces/_/activations?docs=true",
                              headers=HDRS) as r:
                 full = await r.json()
