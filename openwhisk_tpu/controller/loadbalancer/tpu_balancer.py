@@ -386,6 +386,7 @@ class TpuBalancer(CommonLoadBalancer):
         self.supervision.start()
 
     async def close(self) -> None:
+        self._closing = True  # no new flush tasks from here on
         await self.supervision.stop()
         if self._flush_task:
             self._flush_task.cancel()
@@ -399,6 +400,10 @@ class TpuBalancer(CommonLoadBalancer):
             self._slots.release(slot_key, req["conc_slot"])
             if not fut.done():
                 fut.set_exception(LoadBalancerException("load balancer shut down"))
+        # releases queued during the readback drain (abandoned publishers)
+        # will never reach a device step now — free their host slots
+        for r in self._releases:
+            self._slots.release(r[4], r[1])
         self._releases.clear()
         await super().close()
 
@@ -431,7 +436,15 @@ class TpuBalancer(CommonLoadBalancer):
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending.append((req, fut, slot_key))
         self._arm_flush(urgent=len(self._pending) >= self.max_batch)
-        inv_idx, forced = await fut
+        try:
+            inv_idx, forced = await fut
+        except asyncio.CancelledError:
+            # Cancelled between set_result and resumption: the placement is
+            # lost to this caller but its capacity is not — give it back the
+            # same way the readback loop does for futures cancelled earlier.
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                self._abandon_placement(int(fut.result()[0]), req, slot_key)
+            raise
         if inv_idx < 0:
             self._slots.release(slot_key, req["conc_slot"])
             raise LoadBalancerException(
@@ -445,6 +458,19 @@ class TpuBalancer(CommonLoadBalancer):
             entry.conc_slot = req["conc_slot"]
         await self.send_activation_to_invoker(msg, invoker)
         return promise
+
+    def _abandon_placement(self, inv_idx: int, req: dict, slot_key: str) -> None:
+        """A publisher went away (client disconnect) after its request was
+        (or will never be) placed. Route the reserved capacity through the
+        normal release queue — which also frees the host conc slot at drain
+        time, keeping the slot index pinned to this action until the
+        device-side decrement lands."""
+        if inv_idx >= 0:
+            self._releases.append((inv_idx, req["conc_slot"], req["need_mb"],
+                                   req["max_conc"], slot_key))
+            self._arm_flush()
+        else:
+            self._slots.release(slot_key, req["conc_slot"])
 
     # -- completion hooks --------------------------------------------------
     def release_invoker(self, invoker: InvokerInstanceId, entry) -> None:
@@ -526,6 +552,8 @@ class TpuBalancer(CommonLoadBalancer):
 
     # -- the device step ---------------------------------------------------
     def _arm_flush(self, urgent: bool = False) -> None:
+        if getattr(self, "_closing", False):
+            return  # close() drains queued releases host-side itself
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_event_loop().create_task(
                 self._flush_later(0 if urgent else self.batch_window))
@@ -640,10 +668,12 @@ class TpuBalancer(CommonLoadBalancer):
             self.state, chosen, forced = self._packed_fn(
                 self.state, rel_np, health_np, req_np)
         except Exception as e:  # noqa: BLE001 — a failed dispatch must not
-            # leak the permit or strand the publishers (device capacity from
-            # the drained releases is recovered by forced-timeout self-heal)
+            # leak the permit, the host-side conc slots, or strand the
+            # publishers (device capacity from the drained releases is
+            # recovered by forced-timeout self-heal)
             self._inflight.release()
-            for _, fut, _ in batch:
+            for req, fut, slot_key in batch:
+                self._slots.release(slot_key, req["conc_slot"])
                 if not fut.done():
                     fut.set_exception(
                         LoadBalancerException(f"device dispatch failed: {e}"))
@@ -659,21 +689,47 @@ class TpuBalancer(CommonLoadBalancer):
         # throughput at batch/RTT. Dispatch stays event-loop-serialized
         # under the step lock; only readbacks overlap.
         task = asyncio.get_event_loop().create_task(
-            self._readback_step(batch, b, chosen, forced, t0))
+            self._readback_step(batch, b, chosen, forced, t0, req_np))
         self._readbacks.add(task)
         task.add_done_callback(self._readbacks.discard)
 
-    async def _readback_step(self, batch, b, chosen, forced, t0) -> None:
+    def _read_back(self, chosen, forced):
+        """Device->host conversion seam (runs on the worker thread);
+        a separate method so tests can inject readback failures."""
+        return np.asarray(chosen), np.asarray(forced)
+
+    async def _readback_step(self, batch, b, chosen, forced, t0, req_np
+                             ) -> None:
         # the step-duration stamp is taken ON the worker thread so the
         # metric measures device step + readback, not loop re-scheduling
         def _read():
-            out = (np.asarray(chosen), np.asarray(forced))
-            return out, time.monotonic()
+            return self._read_back(chosen, forced), time.monotonic()
 
         try:
             (chosen_np, forced_np), t_done = await asyncio.to_thread(_read)
-        except Exception as e:  # noqa: BLE001 — publishers must not hang
-            for _, fut, _ in batch:
+        except Exception as e:  # noqa: BLE001 — publishers must not hang,
+            # and their host-side conc slots must not leak. The DISPATCH
+            # succeeded (only the host conversion failed), so the device
+            # state holds this batch's placements with no publisher left to
+            # ever release them. Reverse them ON DEVICE — `chosen` is still
+            # a device array, so no readback is needed to undo exactly what
+            # the schedule fold acquired (release_batch is its inverse).
+            compensated = True
+            try:
+                rel = jnp.stack([
+                    jnp.maximum(chosen, 0).astype(jnp.int32),
+                    jnp.asarray(req_np[5]), jnp.asarray(req_np[4]),
+                    jnp.asarray(req_np[6]),
+                    jnp.asarray(req_np[8]) * (chosen >= 0).astype(jnp.int32)])
+                self.state = self._release_packed_fn(self.state, rel)
+            except Exception:  # noqa: BLE001 — device genuinely dead: keep
+                # the host refcounts PINNED so the slot indices cannot be
+                # reassigned to a different action and inherit the phantom
+                # concurrency; restart/self-heal owns recovery from here
+                compensated = False
+            for req, fut, slot_key in batch:
+                if compensated:
+                    self._slots.release(slot_key, req["conc_slot"])
                 if not fut.done():
                     fut.set_exception(
                         LoadBalancerException(f"device step failed: {e}"))
@@ -681,15 +737,22 @@ class TpuBalancer(CommonLoadBalancer):
             # already surfaced through the futures — re-raising would only
             # produce unretrieved-task noise on the loop
             if self.logger:
-                self.logger.error(None, f"device readback failed: {e!r}",
+                self.logger.error(None, f"device readback failed: {e!r} "
+                                  f"(compensated={compensated})",
                                   "TpuBalancer")
             return
         self._inflight.release()
         dt_ms = (t_done - t0) * 1e3
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)
-        for (_, fut, _), inv_idx, f in zip(batch, chosen_np, forced_np):
-            if not fut.done():
+        for (req, fut, slot_key), inv_idx, f in zip(batch, chosen_np,
+                                                    forced_np):
+            if fut.cancelled():
+                # abandoned publisher (client disconnected while awaiting
+                # placement): nobody will ever ack this activation, so give
+                # back what the schedule fold reserved for it
+                self._abandon_placement(int(inv_idx), req, slot_key)
+            elif not fut.done():
                 fut.set_result((int(inv_idx), bool(f)))
 
 

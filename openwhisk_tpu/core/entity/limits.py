@@ -17,10 +17,11 @@ from .size import MB, ByteSize
 
 
 def _int_value(j, what: str) -> int:
-    """Limits are integers on the wire; anything else — booleans, non-integral
-    or non-finite floats, unparsable strings — is a malformed body, not a
-    server error (and not a silent truncation)."""
-    if isinstance(j, bool) or not isinstance(j, (int, float, str)):
+    """Limits are JSON numbers on the wire; anything else — booleans,
+    strings (even numeric ones, matching the reference's JsNumber-only
+    contract), non-integral or non-finite floats — is a malformed body,
+    not a server error (and not a silent truncation)."""
+    if isinstance(j, bool) or not isinstance(j, (int, float)):
         raise MalformedEntity(f"{what} limit must be an integer")
     try:
         n = int(j)

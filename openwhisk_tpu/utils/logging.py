@@ -119,11 +119,19 @@ def _prom_name(name: str) -> str:
     return "openwhisk_" + "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
+def _prom_label_value(v) -> str:
+    """Prometheus exposition format: label values escape backslash,
+    double-quote and newline. The `metric` label comes from user-event
+    bodies, so arbitrary values must not corrupt the page."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_series(key) -> str:
     name, tags = key
     n = _prom_name(name)
     if tags:
-        lbl = ",".join(f'{k}="{v}"' for k, v in tags)
+        lbl = ",".join(f'{k}="{_prom_label_value(v)}"' for k, v in tags)
         return f"{n}{{{lbl}}}"
     return n
 

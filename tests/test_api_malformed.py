@@ -55,7 +55,10 @@ class TestParsersRejectWrongTypes:
             with pytest.raises(MalformedEntity):
                 ActionLimits.from_json(bad)
         assert ActionLimits.from_json({"timeout": 60000}).timeout.millis == 60000
-        assert ActionLimits.from_json({"memory": "256"}).memory.megabytes == 256
+        # numeric STRINGS are malformed too: the reference accepts only
+        # JsNumber limit values
+        with pytest.raises(MalformedEntity):
+            ActionLimits.from_json({"memory": "256"})
 
     def test_exec(self):
         for bad in ("notadict", {"kind": []}, {"kind": "blackbox"},

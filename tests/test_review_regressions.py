@@ -311,3 +311,144 @@ def test_rev_guard_does_not_thrash_on_older_routed_rev():
         got = await es.get_action("ns/b", rev=rev2.rev)
         assert got.exec.code == "v2" and loads == 0
     run(go())
+
+
+def test_device_failure_paths_release_conc_slots():
+    """Advisor r4: a device dispatch (or readback) failure must release the
+    host-side concurrency slots acquired in publish() — otherwise every
+    failed batch permanently leaks refcounts and the zero-refcount invariant
+    the soak simulation asserts is violated."""
+    from openwhisk_tpu.controller.loadbalancer import (LoadBalancerException,
+                                                       TpuBalancer)
+    from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+    from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+    async def go():
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          batch_window=0.002, max_batch=8)
+        await bal.start()
+        invokers, producer = await _fleet(provider, 2)
+        await _ping_all(invokers, producer)
+        ident = Identity.generate("guest")
+        action = make_action("boom", memory=128)
+
+        def explode(*a, **k):
+            raise RuntimeError("injected device fault")
+
+        bal._packed_fn = explode
+        with pytest.raises(LoadBalancerException):
+            await bal.publish(action, make_msg(action, ident, True))
+        leaked = sum(bal._slots.refcount.values())
+        await bal.close()
+        for inv in invokers:
+            await inv.stop()
+        return leaked
+
+    assert run(go()) == 0
+
+
+def test_prometheus_label_values_escaped():
+    """Advisor r4: label values from user-event bodies (metricName) must not
+    corrupt the exposition page — escape backslash, quote, newline."""
+    from openwhisk_tpu.utils.logging import MetricEmitter
+
+    m = MetricEmitter()
+    m.counter("userevents_total", tags={"metric": 'bad"value\nwith\\stuff'})
+    page = m.prometheus_text()
+    line = [l for l in page.splitlines() if l.startswith("openwhisk_userevents_total{")][0]
+    assert '\n' not in line  # splitlines guarantees it, but the raw value had one
+    assert 'bad\\"value\\nwith\\\\stuff' in line
+
+
+def test_readback_failure_reverses_device_placements():
+    """r5 review: when the dispatch succeeds but the host readback fails,
+    the batch's placements live on device with no publisher left to release
+    them. The balancer must reverse them on device (release fold inverts the
+    schedule fold) before freeing the host slots — otherwise a later action
+    reusing the slot index inherits phantom concurrency."""
+    import numpy as np
+
+    from openwhisk_tpu.controller.loadbalancer import (LoadBalancerException,
+                                                       TpuBalancer)
+    from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+    from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+    async def go():
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          batch_window=0.002, max_batch=8)
+        await bal.start()
+        invokers, producer = await _fleet(provider, 2)
+        await _ping_all(invokers, producer)
+        free0 = np.asarray(bal.state.free_mb).copy()
+        conc0 = np.asarray(bal.state.conc_free).copy()
+
+        def poisoned(chosen, forced):
+            raise RuntimeError("tunnel died mid-readback")
+
+        bal._read_back = poisoned
+        ident = Identity.generate("guest")
+        action = make_action("phantom", memory=256)
+        with pytest.raises(LoadBalancerException):
+            await bal.publish(action, make_msg(action, ident, True))
+        leaked = sum(bal._slots.refcount.values())
+        free1 = np.asarray(bal.state.free_mb).copy()
+        conc1 = np.asarray(bal.state.conc_free).copy()
+        await bal.close()
+        for inv in invokers:
+            await inv.stop()
+        return leaked, (free0 == free1).all(), (conc0 == conc1).all()
+
+    leaked, free_ok, conc_ok = run(go())
+    assert leaked == 0
+    assert free_ok and conc_ok
+
+
+def test_cancelled_publisher_releases_capacity():
+    """r5 review: a publish() cancelled while awaiting placement (client
+    disconnect) must not leak its host conc slot nor the device capacity the
+    schedule fold reserved for it."""
+    import numpy as np
+
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+    from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+    async def go():
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          batch_window=0.002, max_batch=8)
+        await bal.start()
+        invokers, producer = await _fleet(provider, 2)
+        await _ping_all(invokers, producer)
+        free0 = np.asarray(bal.state.free_mb).copy()
+        conc0 = np.asarray(bal.state.conc_free).copy()
+        ident = Identity.generate("guest")
+        action = make_action("gone", memory=256)
+        task = asyncio.get_event_loop().create_task(
+            bal.publish(action, make_msg(action, ident, True)))
+        await asyncio.sleep(0)  # let publish enqueue into _pending
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        # the batch still dispatches; the abandoned release then drains
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if (sum(bal._slots.refcount.values()) == 0
+                    and (np.asarray(bal.state.free_mb) == free0).all()):
+                break
+        leaked = sum(bal._slots.refcount.values())
+        free1 = np.asarray(bal.state.free_mb).copy()
+        conc1 = np.asarray(bal.state.conc_free).copy()
+        await bal.close()
+        for inv in invokers:
+            await inv.stop()
+        return leaked, (free0 == free1).all(), (conc0 == conc1).all()
+
+    leaked, free_ok, conc_ok = run(go())
+    assert leaked == 0
+    assert free_ok and conc_ok

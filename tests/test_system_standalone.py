@@ -96,9 +96,16 @@ class TestStandaloneSystem:
             async with s.get(f"{BASE}/namespaces/_/activations/{aid}/logs",
                              headers=HDRS) as r:
                 out["logs"] = (await r.json())["logs"]
-            async with s.get(f"{BASE}/namespaces/_/activations?limit=10",
-                             headers=HDRS) as r:
-                out["act_list"] = len(await r.json())
+            # activation records land asynchronously after the blocking
+            # ack: poll the list until both invokes are visible
+            out["act_list"] = 0
+            for _ in range(40):
+                async with s.get(f"{BASE}/namespaces/_/activations?limit=10",
+                                 headers=HDRS) as r:
+                    out["act_list"] = len(await r.json())
+                if out["act_list"] >= 2:
+                    break
+                await asyncio.sleep(0.25)
             # delete
             async with s.delete(f"{BASE}/namespaces/_/actions/hello", headers=HDRS) as r:
                 out["delete"] = r.status
