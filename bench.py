@@ -16,8 +16,12 @@ What runs (default, no args):
      same batch; chosen/forced/books compared exactly.
   4. Balancer-level benchmark: TpuBalancer.publish() -> placement future,
      echo invokers on the in-memory bus — activations/s and p50/p99
-     publish->placement latency at the default batch window (the host-side
-     batch assembly + asyncio + promise fan-out the device number omits).
+     publish->placement latency at client concurrencies c=64/8/1, each with
+     a phase breakdown (assembly / dispatch / readback / fan-out ms). Two
+     runs: the default backend (through the tunnel every device step costs a
+     ~70 ms wire round trip), and a CPU-backend subprocess — the HOST-PATH
+     row, showing what the host machinery sustains when the device is
+     PCIe-local (as on a real TPU host) rather than behind a WAN tunnel.
 
 `--kernel xla|pallas` restricts step 1-2 to one kernel; `--quick` skips the
 balancer bench; `--sweep` prints an (N invokers x A slots) xla-vs-pallas
@@ -286,6 +290,9 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         for _ in range(2):
             await asyncio.gather(*[one(i) for i in range(min(128, total))])
         lat.clear()
+        # fresh metrics: the warmup rounds polluted the phase histograms
+        # with first-call jit-compile outliers (hundreds of ms dispatches)
+        bal.metrics = type(bal.metrics)()
         t0 = time.perf_counter()
         await asyncio.gather(*[one(i) for i in range(total)])
         wall = time.perf_counter() - t0
@@ -296,15 +303,67 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
             await f.stop()
 
         lat.sort()
+        phases = {}
+        for ph in ("assembly", "dispatch", "readback", "fanout"):
+            st = bal.metrics.histogram_stats(f"loadbalancer_tpu_{ph}_ms")
+            if st:
+                phases[ph] = {"p50_ms": round(st["p50"], 3),
+                              "mean_ms": round(st["mean"], 3)}
+        bs = bal.metrics.histogram_stats("loadbalancer_tpu_batch_size")
         return {
             "activations_per_sec": round(total / wall, 1),
             "publish_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
             "publish_p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
             "concurrency": concurrency,
             "n_invokers": n_invokers,
+            "phases": phases,
+            "batch_size_mean": round(bs["mean"], 1) if bs else None,
         }
 
     return asyncio.run(go())
+
+
+def _balancer_rows() -> dict:
+    """The balancer stage at three client concurrencies: c=64 is the
+    throughput row, c=8 the mid point, c=1 isolates the batching window's
+    idle-latency cost (SURVEY §7's batching-vs-latency tension as a
+    measured number)."""
+    return {
+        "c64": _balancer_bench(total=2000, concurrency=64),
+        "c8": _balancer_bench(total=600, concurrency=8),
+        "c1": _balancer_bench(total=150, concurrency=1),
+    }
+
+
+def _balancer_host_rows() -> Optional[dict]:
+    """The same balancer rows forced onto the CPU backend in a subprocess:
+    the HOST-PATH measure. Through a tunneled chip every device step costs a
+    wire round trip (~70 ms here) that does not exist on a real TPU host
+    (PCIe-local chips); the CPU-backend run shows what the host machinery
+    itself sustains with the device round trip out of the picture."""
+    import os
+    import subprocess
+    code = (
+        "import os, json\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
+        "' --xla_force_host_platform_device_count=8'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        "print('BENCHJSON:' + json.dumps(bench._balancer_rows()))\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=1200)
+        for line in out.stdout.splitlines():
+            if line.startswith("BENCHJSON:"):
+                return json.loads(line[len("BENCHJSON:"):])
+        print(f"# balancer host-path run failed: {out.stderr[-400:]}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — host row is auxiliary
+        print(f"# balancer host-path run failed: {e!r}", file=sys.stderr)
+    return None
 
 
 def _cpu_oracle_rate(n: int = N_INVOKERS, reqs: int = 2048) -> float:
@@ -381,7 +440,19 @@ def main() -> None:
 
     parity_ok = _parity_check() if args.kernel == "both" else None
 
-    balancer = None if args.quick else _balancer_bench()
+    balancer = None
+    balancer_host = None
+    if not args.quick:
+        rows = _balancer_rows()
+        # c64 stays flattened at the top level (older readers); the rows
+        # dict carries the per-concurrency detail + phase breakdowns
+        balancer = {"backend": jax.default_backend(), **rows["c64"],
+                    "rows": rows}
+        if jax.default_backend() != "cpu":
+            host_rows = _balancer_host_rows()
+            if host_rows:
+                balancer_host = {"backend": "cpu", **host_rows["c64"],
+                                 "rows": host_rows}
 
     cpu_rate = _cpu_oracle_rate()
     headline = kernels.get("xla") or kernels["pallas"]
@@ -402,6 +473,8 @@ def main() -> None:
     }
     if balancer is not None:
         out["balancer"] = balancer
+    if balancer_host is not None:
+        out["balancer_host_path"] = balancer_host
     print(json.dumps(out))
 
 

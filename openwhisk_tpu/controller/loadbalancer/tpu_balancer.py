@@ -47,7 +47,8 @@ from ...models.sharding_policy import (MIN_SLOT_MB, generate_hash,
                                        pairwise_coprimes)
 from ...ops.placement import (PlacementState, RequestBatch, init_state,
                               make_fused_step_packed, make_release_packed,
-                              release_batch, schedule_batch, set_health)
+                              release_batch, schedule_batch, set_health,
+                              unpack_chosen)
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException)
 from .supervision import InvokerPool
@@ -185,17 +186,26 @@ class TpuBalancer(CommonLoadBalancer):
         self._init_device_state()
 
         # pending request queue + delta buffers
-        self._pending: List[tuple] = []      # (req_dict, future)
+        self._pending: List[tuple] = []      # (req_tuple, future, slot_key)
         self._releases: List[tuple] = []     # (inv_idx, slot, mem, maxc, key)
         self._health_updates: Dict[int, bool] = {}
         self._flush_task: Optional[asyncio.Task] = None
         self._step_lock = asyncio.Lock()
         # device-step pipelining: dispatch is async (JAX returns future
         # arrays immediately), so batch N+1 can be dispatched while batch
-        # N's readback is still crossing the wire — the semaphore bounds
-        # in-flight readbacks, the task set tracks them for close()
-        self._inflight = asyncio.Semaphore(max(1, pipeline_depth))
+        # N's readback is still crossing the wire — the counter bounds
+        # in-flight readbacks (the event wakes waiters when one lands),
+        # the task set tracks them for close()
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._inflight_steps = 0
+        self._capacity_free = asyncio.Event()
         self._readbacks: set = set()
+        #: EWMA of the device readback round trip — picks the eager-vs-
+        #: batching dispatch policy (tunnel RTTs serialize; local ones
+        #: don't). Starts ABOVE the fast threshold: unknown counts as slow,
+        #: because misclassifying a tunnel as fast costs a serialized wire
+        #: round trip while the reverse costs one event-loop tick.
+        self._rtt_ewma_ms = 2 * self.RTT_FAST_MS
 
         # group is per-controller: every controller needs its OWN full view
         # of the ping stream (a shared group would split pings between
@@ -397,7 +407,7 @@ class TpuBalancer(CommonLoadBalancer):
         # fail queued publishers instead of leaving them awaiting forever
         pending, self._pending = self._pending, []
         for req, fut, slot_key in pending:
-            self._slots.release(slot_key, req["conc_slot"])
+            self._slots.release(slot_key, req[self.R_CONC_SLOT])
             if not fut.done():
                 fut.set_exception(LoadBalancerException("load balancer shut down"))
         # releases queued during the readback drain (abandoned publishers)
@@ -427,15 +437,26 @@ class TpuBalancer(CommonLoadBalancer):
         maxc = action.limits.concurrency.max_concurrent
         slot_key = f"{action.fully_qualified_name}:{mem}"
         self._ensure_slot_capacity(slot_key)
-        req = {
-            "offset": offset, "size": size, "home": h % size,
-            "step_inv": _mod_inverse(step, size), "need_mb": mem,
-            "conc_slot": self._slots.acquire(slot_key), "max_conc": maxc,
-            "rand": (h ^ (self._rand_counter * 2654435761)) % max(size, 1),
-        }
+        # request row in packed-matrix order (see _dispatch_batch): a plain
+        # tuple converts to the int32 batch matrix in one C-speed np.array
+        # call instead of a per-field Python fill loop
+        req = (offset, size, h % size, _mod_inverse(step, size), mem,
+               self._slots.acquire(slot_key), maxc,
+               (h ^ (self._rand_counter * 2654435761)) % max(size, 1), 1)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending.append((req, fut, slot_key))
-        self._arm_flush(urgent=len(self._pending) >= self.max_batch)
+        # inline fast path: with free pipeline capacity, dispatch NOW
+        # (synchronously — the assembly+enqueue body has no awaits) when the
+        # batch is full, or on an idle FAST device (sub-window round trips:
+        # overlap is real, so eager dispatch just cuts latency). On a
+        # slow/tunneled device round trips serialize, so splitting an
+        # arrival wave into eager sub-batches multiplies wire time —
+        # measured RTT (EWMA of the readback histogram) picks the policy.
+        if not ((len(self._pending) >= self.max_batch
+                 or (self._inflight_steps == 0
+                     and self._rtt_ewma_ms < self.RTT_FAST_MS))
+                and self._try_flush_now()):
+            self._arm_flush(urgent=len(self._pending) >= self.max_batch)
         try:
             inv_idx, forced = await fut
         except asyncio.CancelledError:
@@ -446,7 +467,7 @@ class TpuBalancer(CommonLoadBalancer):
                 self._abandon_placement(int(fut.result()[0]), req, slot_key)
             raise
         if inv_idx < 0:
-            self._slots.release(slot_key, req["conc_slot"])
+            self._slots.release(slot_key, req[self.R_CONC_SLOT])
             raise LoadBalancerException(
                 "No invokers available to schedule the activation.")
         if forced:
@@ -455,22 +476,22 @@ class TpuBalancer(CommonLoadBalancer):
         promise = self.setup_activation(msg, action, invoker)
         entry = self.activation_slots.get(msg.activation_id.asString)
         if entry is not None:
-            entry.conc_slot = req["conc_slot"]
+            entry.conc_slot = req[self.R_CONC_SLOT]
         await self.send_activation_to_invoker(msg, invoker)
         return promise
 
-    def _abandon_placement(self, inv_idx: int, req: dict, slot_key: str) -> None:
+    def _abandon_placement(self, inv_idx: int, req: tuple, slot_key: str) -> None:
         """A publisher went away (client disconnect) after its request was
         (or will never be) placed. Route the reserved capacity through the
         normal release queue — which also frees the host conc slot at drain
         time, keeping the slot index pinned to this action until the
         device-side decrement lands."""
         if inv_idx >= 0:
-            self._releases.append((inv_idx, req["conc_slot"], req["need_mb"],
-                                   req["max_conc"], slot_key))
+            self._releases.append((inv_idx, req[self.R_CONC_SLOT], req[self.R_NEED_MB],
+                                   req[self.R_MAX_CONC], slot_key))
             self._arm_flush()
         else:
-            self._slots.release(slot_key, req["conc_slot"])
+            self._slots.release(slot_key, req[self.R_CONC_SLOT])
 
     # -- completion hooks --------------------------------------------------
     def release_invoker(self, invoker: InvokerInstanceId, entry) -> None:
@@ -554,6 +575,11 @@ class TpuBalancer(CommonLoadBalancer):
     def _arm_flush(self, urgent: bool = False) -> None:
         if getattr(self, "_closing", False):
             return  # close() drains queued releases host-side itself
+        # idle fast path: with no step in flight there is nothing to batch
+        # WITH — waiting out the window would only add latency (the window
+        # exists to amortize a round trip that is already being paid)
+        if self._inflight_steps == 0 and self._pending:
+            urgent = True
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_event_loop().create_task(
                 self._flush_later(0 if urgent else self.batch_window))
@@ -570,11 +596,19 @@ class TpuBalancer(CommonLoadBalancer):
                 return
             delay = self.batch_window
 
+    #: request-tuple field indices (row order of the packed matrix)
+    R_NEED_MB, R_CONC_SLOT, R_MAX_CONC = 4, 5, 6
+
     #: health updates drained per device step — a FIXED batch shape, so the
     #: fused program's compile-cache keys vary only in (release, batch)
     #: buckets; leftovers roll to the next step (fleet churn is slow vs the
     #: step rate)
     HEALTH_BATCH = 64
+
+    #: below this measured round trip the device counts as "fast": eager
+    #: idle dispatch wins; above it, wave batching wins (round trips on a
+    #: tunneled device serialize rather than pipeline)
+    RTT_FAST_MS = 5.0
 
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
@@ -593,9 +627,9 @@ class TpuBalancer(CommonLoadBalancer):
         b = self._bucket(len(rel), cap) if rel else 8
         out = np.zeros((5, b), np.int32)
         out[3, len(rel):] = 1  # padded rows: maxc=1
-        for j, r in enumerate(rel):
-            out[0, j], out[1, j], out[2, j], out[3, j] = r[0], r[1], r[2], r[3]
-            out[4, j] = 1
+        if rel:
+            out[:4, :len(rel)] = np.array([r[:4] for r in rel], np.int32).T
+            out[4, :len(rel)] = 1
         for r in rel:
             self._slots.release(r[4], r[1])
         return out
@@ -617,6 +651,18 @@ class TpuBalancer(CommonLoadBalancer):
             out[2] = 1
         return out
 
+    def _try_flush_now(self) -> bool:
+        """Synchronous dispatch fast path: runs the batch dispatch inline
+        when the pipeline has capacity and no flush task is mid-step. The
+        dispatch body has no awaits, so it is atomic on the event loop."""
+        if (self._pending and not self._step_lock.locked()
+                and self._inflight_steps < self.pipeline_depth
+                and not getattr(self, "_closing", False)):
+            self._inflight_steps += 1
+            self._dispatch_batch()
+            return True
+        return False
+
     async def _device_step(self) -> None:
         if not self._pending:
             # nothing to schedule: fold releases (padded+masked like the
@@ -630,50 +676,53 @@ class TpuBalancer(CommonLoadBalancer):
                                         list(ups.values()))
             return
 
-        # bound dispatched-but-unread steps (permit released by the readback
+        # bound dispatched-but-unread steps (capacity freed by the readback
         # task) BEFORE popping the batch: a cancellation while waiting here
         # (close() cancels the flush task) must leave the queue intact so
         # close() can fail those publishers instead of stranding them
-        await self._inflight.acquire()
+        while self._inflight_steps >= self.pipeline_depth:
+            self._capacity_free.clear()
+            await self._capacity_free.wait()
+        self._inflight_steps += 1
+        self._dispatch_batch()
+
+    def _dispatch_batch(self) -> None:
         batch, self._pending = self._pending[: self.max_batch], \
             self._pending[self.max_batch:]
         t0 = time.monotonic()
-        reqs = [r for r, _, _ in batch]
-        b = len(reqs)
+        b = len(batch)
         bp = self._bucket(b, self.max_batch)
         # ONE packed request matrix: row layout must match
-        # make_fused_step_packed (offset..rand, valid); padded request
-        # columns keep size=1/max_conc=1 like the old pad_req dict
+        # make_fused_step_packed (offset..rand, valid); request tuples are
+        # already in row order, so one C-speed np.array call fills it.
+        # Padded request columns keep size=1/max_conc=1 like the old
+        # pad_req dict
         req_np = np.zeros((9, bp), np.int32)
         req_np[1, b:] = 1  # size
         req_np[6, b:] = 1  # max_conc
-        for j, r in enumerate(reqs):
-            req_np[0, j] = r["offset"]
-            req_np[1, j] = r["size"]
-            req_np[2, j] = r["home"]
-            req_np[3, j] = r["step_inv"]
-            req_np[4, j] = r["need_mb"]
-            req_np[5, j] = r["conc_slot"]
-            req_np[6, j] = r["max_conc"]
-            req_np[7, j] = r["rand"]
-            req_np[8, j] = 1
+        req_np[:, :b] = np.array([r for r, _, _ in batch], np.int32).T
         rel_np = self._release_packed()
         health_np = self._health_packed()
-        # releases + health flips + schedule: ONE device program over THREE
-        # host->device transfers (the old column-wise path did 16 — on a
-        # tunneled chip the transfer round-trips dominated the step). No
+        # releases + health flips + schedule: ONE device program over ONE
+        # host->device transfer and ONE packed result vector back (the old
+        # column-wise path did 16 in + 2 out — on a tunneled chip the
+        # transfer round-trips dominate the step, not the kernel). No
         # await between the pop above and the task creation below, so no
         # cancellation window can orphan the popped batch.
+        buf = np.concatenate([rel_np.ravel(), health_np.ravel(),
+                              req_np.ravel()])
+        t_assembled = time.monotonic()
         try:
-            self.state, chosen, forced = self._packed_fn(
-                self.state, rel_np, health_np, req_np)
+            self.state, out = self._packed_fn(
+                self.state, buf, rel_np.shape[1], health_np.shape[1], bp)
         except Exception as e:  # noqa: BLE001 — a failed dispatch must not
             # leak the permit, the host-side conc slots, or strand the
             # publishers (device capacity from the drained releases is
             # recovered by forced-timeout self-heal)
-            self._inflight.release()
+            self._inflight_steps -= 1
+            self._capacity_free.set()
             for req, fut, slot_key in batch:
-                self._slots.release(slot_key, req["conc_slot"])
+                self._slots.release(slot_key, req[self.R_CONC_SLOT])
                 if not fut.done():
                     fut.set_exception(
                         LoadBalancerException(f"device dispatch failed: {e}"))
@@ -682,6 +731,14 @@ class TpuBalancer(CommonLoadBalancer):
                                   "TpuBalancer")
             return
 
+        # phase breakdown (bench + ops visibility): assembly is host numpy
+        # packing, dispatch is the jit enqueue (transfers + program launch)
+        t_dispatched = time.monotonic()
+        self.metrics.histogram("loadbalancer_tpu_assembly_ms",
+                               (t_assembled - t0) * 1e3)
+        self.metrics.histogram("loadbalancer_tpu_dispatch_ms",
+                               (t_dispatched - t_assembled) * 1e3)
+        self.metrics.histogram("loadbalancer_tpu_batch_size", b)
         # pipelined readback: dispatch returns future arrays immediately, so
         # the NEXT batch can dispatch (chained on device) while this batch's
         # results cross the wire on a worker thread — on a tunneled chip the
@@ -689,21 +746,27 @@ class TpuBalancer(CommonLoadBalancer):
         # throughput at batch/RTT. Dispatch stays event-loop-serialized
         # under the step lock; only readbacks overlap.
         task = asyncio.get_event_loop().create_task(
-            self._readback_step(batch, b, chosen, forced, t0, req_np))
+            self._readback_step(batch, b, out, t0, req_np))
         self._readbacks.add(task)
         task.add_done_callback(self._readbacks.discard)
 
-    def _read_back(self, chosen, forced):
+    def _read_back(self, out):
         """Device->host conversion seam (runs on the worker thread);
         a separate method so tests can inject readback failures."""
-        return np.asarray(chosen), np.asarray(forced)
+        return unpack_chosen(np.asarray(out))
 
-    async def _readback_step(self, batch, b, chosen, forced, t0, req_np
-                             ) -> None:
+    async def _readback_step(self, batch, b, out, t0, req_np) -> None:
         # the step-duration stamp is taken ON the worker thread so the
         # metric measures device step + readback, not loop re-scheduling
         def _read():
-            return self._read_back(chosen, forced), time.monotonic()
+            t_r0 = time.monotonic()
+            arrs = self._read_back(out)
+            t_r1 = time.monotonic()
+            rb_ms = (t_r1 - t_r0) * 1e3
+            self.metrics.histogram("loadbalancer_tpu_readback_ms", rb_ms)
+            # benign cross-thread write: a float EWMA steering a heuristic
+            self._rtt_ewma_ms = 0.8 * self._rtt_ewma_ms + 0.2 * rb_ms
+            return arrs, t_r1
 
         try:
             (chosen_np, forced_np), t_done = await asyncio.to_thread(_read)
@@ -711,11 +774,12 @@ class TpuBalancer(CommonLoadBalancer):
             # and their host-side conc slots must not leak. The DISPATCH
             # succeeded (only the host conversion failed), so the device
             # state holds this batch's placements with no publisher left to
-            # ever release them. Reverse them ON DEVICE — `chosen` is still
+            # ever release them. Reverse them ON DEVICE — `out` is still
             # a device array, so no readback is needed to undo exactly what
             # the schedule fold acquired (release_batch is its inverse).
             compensated = True
             try:
+                chosen, _ = unpack_chosen(out)
                 rel = jnp.stack([
                     jnp.maximum(chosen, 0).astype(jnp.int32),
                     jnp.asarray(req_np[5]), jnp.asarray(req_np[4]),
@@ -729,11 +793,12 @@ class TpuBalancer(CommonLoadBalancer):
                 compensated = False
             for req, fut, slot_key in batch:
                 if compensated:
-                    self._slots.release(slot_key, req["conc_slot"])
+                    self._slots.release(slot_key, req[self.R_CONC_SLOT])
                 if not fut.done():
                     fut.set_exception(
                         LoadBalancerException(f"device step failed: {e}"))
-            self._inflight.release()
+            self._inflight_steps -= 1
+            self._capacity_free.set()
             # already surfaced through the futures — re-raising would only
             # produce unretrieved-task noise on the loop
             if self.logger:
@@ -741,10 +806,12 @@ class TpuBalancer(CommonLoadBalancer):
                                   f"(compensated={compensated})",
                                   "TpuBalancer")
             return
-        self._inflight.release()
+        self._inflight_steps -= 1
+        self._capacity_free.set()
         dt_ms = (t_done - t0) * 1e3
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)
+        t_f0 = time.monotonic()
         for (req, fut, slot_key), inv_idx, f in zip(batch, chosen_np,
                                                     forced_np):
             if fut.cancelled():
@@ -754,6 +821,8 @@ class TpuBalancer(CommonLoadBalancer):
                 self._abandon_placement(int(inv_idx), req, slot_key)
             elif not fut.done():
                 fut.set_result((int(inv_idx), bool(f)))
+        self.metrics.histogram("loadbalancer_tpu_fanout_ms",
+                               (time.monotonic() - t_f0) * 1e3)
 
 
 class TpuBalancerProvider:

@@ -27,7 +27,11 @@ class ActivationId:
 
     @classmethod
     def generate(cls) -> "ActivationId":
-        return cls(uuid.uuid4().hex)
+        # uuid4().hex is 32 lowercase hex by construction — skip the
+        # parse-path normalization/validation on the publish hot path
+        aid = object.__new__(cls)
+        aid.asString = uuid.uuid4().hex
+        return aid
 
     def to_json(self) -> str:
         return self.asString

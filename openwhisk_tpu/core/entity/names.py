@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Optional
 
 ENTITY_NAME_RX = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9@ _\-.]*$")
@@ -15,12 +16,36 @@ DEFAULT_NAMESPACE = "_"
 MAX_NAME_LENGTH = 256
 
 
+@lru_cache(maxsize=8192)
+def _name_ok(name: str) -> bool:
+    """Validation verdict per distinct string: entity names repeat heavily
+    on the hot path (every message parse re-validates the same few action/
+    namespace names), so the regex runs once per distinct name."""
+    return bool(name) and len(name) <= MAX_NAME_LENGTH \
+        and ENTITY_NAME_RX.match(name) is not None
+
+
+@lru_cache(maxsize=8192)
+def _path_segments(path: str) -> tuple:
+    """Split + validate a path once per distinct string (raises on invalid,
+    so the cache only ever holds valid splits). Segments are regex-checked
+    only — EntityPath has never enforced MAX_NAME_LENGTH per segment, and
+    stored documents may rely on that."""
+    segs = tuple(s for s in path.strip("/").split("/") if s != "")
+    if not segs:
+        raise ValueError(f"path {path!r} is not a valid entity path")
+    for s in segs:
+        if s != DEFAULT_NAMESPACE and not ENTITY_NAME_RX.match(s):
+            raise ValueError(f"path segment {s!r} is not valid")
+    return segs
+
+
 @dataclass(frozen=True)
 class EntityName:
     name: str
 
     def __post_init__(self):
-        if not self.name or len(self.name) > MAX_NAME_LENGTH or not ENTITY_NAME_RX.match(self.name):
+        if not _name_ok(self.name):
             raise ValueError(f"name {self.name!r} is not a valid entity name")
 
     def to_path(self) -> "EntityPath":
@@ -39,16 +64,11 @@ class EntityPath:
     path: str
 
     def __post_init__(self):
-        segs = self.segments
-        if not segs or any(not s for s in segs):
-            raise ValueError(f"path {self.path!r} is not a valid entity path")
-        for s in segs:
-            if s != DEFAULT_NAMESPACE and not ENTITY_NAME_RX.match(s):
-                raise ValueError(f"path segment {s!r} is not valid")
+        _path_segments(self.path)  # raises on invalid
 
     @property
     def segments(self):
-        return [s for s in self.path.strip("/").split("/") if s != ""]
+        return list(_path_segments(self.path))
 
     @property
     def root(self) -> EntityName:

@@ -225,24 +225,38 @@ def make_release_packed(release_fn=None):
 def make_fused_step_packed(release_fn=None, schedule_fn=None):
     """Transfer-packed variant of make_fused_step for the balancer's host
     path. The unpacked signature costs 16 host->device transfers per step
-    (8 request columns + 5 release arrays + 3 health arrays); on a tunneled
-    device every transfer is a round trip, so the TRANSFER COUNT — not the
-    kernel — dominates the step. Packing collapses them to 3 int32 matrices
-    (releases [5,R], health [3,H], requests [9,B]); the row unpacking and
-    bool casts fuse into the same compiled program.
+    (8 request columns + 5 release arrays + 3 health arrays) and 2 reads
+    back; on a tunneled device every transfer is a round trip, so the
+    TRANSFER COUNT — not the kernel — dominates the step. Packing collapses
+    the inputs to ONE flat int32 buffer (rel [5*R] ++ health [3*H] ++ req
+    [9*B], split by static shape inside the program) and the outputs to ONE
+    int32 vector (((chosen+1)<<1)|forced — callers decode with
+    `unpack_chosen`). R/H/B are static per compile; the balancer's
+    power-of-two bucketing bounds the cache-key count.
     """
     fused = make_fused_step(release_fn, schedule_fn)
 
-    @jax.jit
-    def packed(state: PlacementState, rel, health, req):
-        # rel    int32[5,R]: inv, slot, mem, maxc, valid
-        # health int32[3,H]: idx, val, mask
-        # req    int32[9,B]: offset, size, home, step_inv, need_mb,
-        #                    conc_slot, max_conc, rand, valid
+    @partial(jax.jit, static_argnums=(2, 3, 4))
+    def packed(state: PlacementState, buf, R: int, H: int, B: int):
+        # buf int32[5R+3H+9B]:
+        #   rel    [5,R]: inv, slot, mem, maxc, valid
+        #   health [3,H]: idx, val, mask
+        #   req    [9,B]: offset, size, home, step_inv, need_mb,
+        #                 conc_slot, max_conc, rand, valid
+        rel = buf[:5 * R].reshape(5, R)
+        health = buf[5 * R:5 * R + 3 * H].reshape(3, H)
+        req = buf[5 * R + 3 * H:].reshape(9, B)
         batch = RequestBatch(req[0], req[1], req[2], req[3], req[4], req[5],
                              req[6], req[7], req[8].astype(bool))
-        return fused(state, rel[0], rel[1], rel[2], rel[3],
-                     rel[4].astype(bool), health[0],
-                     health[1].astype(bool), health[2].astype(bool), batch)
+        state, chosen, forced = fused(
+            state, rel[0], rel[1], rel[2], rel[3], rel[4].astype(bool),
+            health[0], health[1].astype(bool), health[2].astype(bool), batch)
+        return state, ((chosen + 1) << 1) | forced.astype(jnp.int32)
 
     return packed
+
+
+def unpack_chosen(out):
+    """Decode make_fused_step_packed's packed output vector (host numpy or
+    device jnp): -> (chosen int32, forced bool)."""
+    return (out >> 1) - 1, (out & 1).astype(bool)

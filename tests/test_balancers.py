@@ -387,12 +387,15 @@ class TestReviewRegressions:
             provider = MemoryMessagingProvider()
             bal = TpuBalancer(provider, ControllerInstanceId("0"),
                               managed_fraction=1.0, blackbox_fraction=0.0,
-                              batch_window=5.0)  # long window: stays buffered
+                              batch_window=5.0, pipeline_depth=1)
             await bal.start()
             invokers, producer = await _fleet(provider, 1)
             await _ping_all(invokers, producer)
             ident = Identity.generate("guest")
             action = make_action()
+            # saturate the pipeline so the publish stays buffered (an idle
+            # balancer flushes immediately; a busy one batches)
+            bal._inflight_steps = bal.pipeline_depth
             task = asyncio.get_event_loop().create_task(
                 bal.publish(action, make_msg(action, ident, True)))
             await asyncio.sleep(0.05)
@@ -597,15 +600,17 @@ class TestPipelinedSteps:
     def test_close_fails_queued_publishers_without_hanging(self):
         async def go():
             provider = MemoryMessagingProvider()
-            # a far-away batch window keeps the publishes queued
+            # a saturated pipeline + far-away window keeps publishes queued
+            # (an idle balancer flushes immediately; a busy one batches)
             bal = TpuBalancer(provider, ControllerInstanceId("0"),
                               managed_fraction=1.0, blackbox_fraction=0.0,
-                              batch_window=30.0)
+                              batch_window=30.0, pipeline_depth=1)
             await bal.start()
             invokers, producer = await _fleet(provider, 2)
             await _ping_all(invokers, producer)
             ident = Identity.generate("guest")
             action = make_action()
+            bal._inflight_steps = bal.pipeline_depth
             tasks = [asyncio.create_task(
                 bal.publish(action, make_msg(action, ident, blocking=True)))
                 for _ in range(4)]

@@ -386,7 +386,7 @@ def test_readback_failure_reverses_device_placements():
         free0 = np.asarray(bal.state.free_mb).copy()
         conc0 = np.asarray(bal.state.conc_free).copy()
 
-        def poisoned(chosen, forced):
+        def poisoned(out):
             raise RuntimeError("tunnel died mid-readback")
 
         bal._read_back = poisoned
