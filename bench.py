@@ -176,87 +176,108 @@ def _parity_check(n_invokers: int = 512, action_slots: int = 128) -> bool:
     return ok
 
 
+def _bench_action(name, memory=256):
+    from openwhisk_tpu.core.entity import (ActionLimits, CodeExec, EntityName,
+                                           EntityPath, ExecutableWhiskAction,
+                                           MB, MemoryLimit, TimeLimit)
+    from openwhisk_tpu.core.entity.ids import DocRevision
+
+    a = ExecutableWhiskAction(EntityPath("guest"), EntityName(name),
+                              CodeExec(kind="python:3", code="x"),
+                              limits=ActionLimits(TimeLimit(5000),
+                                                  MemoryLimit(MB(memory))))
+    a.rev = DocRevision("1-b")
+    return a
+
+
+async def _echo_invoker(provider, instance):
+    """An invoker stand-in: consumes its topic, acks every activation
+    immediately with a successful record (pure control-plane load)."""
+    from openwhisk_tpu.core.entity import (ActivationResponse, EntityPath,
+                                           WhiskActivation)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         CombinedCompletionAndResultMessage,
+                                         MessageFeed)
+
+    topic = instance.as_string
+    provider.ensure_topic(topic)
+    consumer = provider.get_consumer(topic, topic)
+    producer = provider.get_producer()
+    box = {}
+
+    async def handle(payload: bytes):
+        msg = ActivationMessage.parse(payload)
+        now = time.time()
+        act = WhiskActivation(
+            EntityPath(str(msg.user.namespace.name)), msg.action.name,
+            msg.user.subject, msg.activation_id, now, now,
+            ActivationResponse.success({"ok": True}), duration=1)
+        await producer.send(
+            f"completed{msg.root_controller_index.as_string}",
+            CombinedCompletionAndResultMessage(msg.transid, act, instance))
+        box["feed"].processed()
+
+    feed = MessageFeed(topic, consumer, 256, handle)
+    box["feed"] = feed
+    feed.start()
+    return feed
+
+
+async def _echo_fleet(provider, n_invokers):
+    """Start `n_invokers` echo invokers + a 1 Hz pinger (supervision marks a
+    fleet Offline after 10 s of silence, which a cold first compile easily
+    outlasts). Returns (feeds, stop) — await stop() to end the pinger."""
+    from openwhisk_tpu.core.entity import MB, InvokerInstanceId
+    from openwhisk_tpu.messaging import PingMessage
+
+    producer = provider.get_producer()
+    provider.ensure_topic("health")
+    feeds, instances = [], []
+    for i in range(n_invokers):
+        inst = InvokerInstanceId(i, user_memory=MB(8192))
+        instances.append(inst)
+        feeds.append(await _echo_invoker(provider, inst))
+        await producer.send("health", PingMessage(inst))
+    stop_ping = asyncio.Event()
+
+    async def pinger():
+        while not stop_ping.is_set():
+            for inst in instances:
+                await producer.send("health", PingMessage(inst))
+            try:
+                await asyncio.wait_for(stop_ping.wait(), 1.0)
+            except asyncio.TimeoutError:
+                pass
+
+    ping_task = asyncio.ensure_future(pinger())
+
+    async def stop():
+        stop_ping.set()
+        await ping_task
+
+    return feeds, stop
+
+
 def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                     concurrency: int = 64) -> dict:
     """TpuBalancer.publish() end-to-end on the in-memory bus with echo
     invokers: the full host path (slot alloc, micro-batch assembly, device
     step, promise fan-out, bus send) that the raw kernel number omits."""
     from openwhisk_tpu.controller.loadbalancer import TpuBalancer
-    from openwhisk_tpu.core.entity import (ActionLimits, ActivationId,
-                                           ActivationResponse, CodeExec,
-                                           ControllerInstanceId, EntityName,
-                                           EntityPath, ExecutableWhiskAction,
-                                           Identity, InvokerInstanceId, MB,
-                                           MemoryLimit, TimeLimit,
-                                           WhiskActivation)
-    from openwhisk_tpu.core.entity.ids import DocRevision
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
     from openwhisk_tpu.messaging import (ActivationMessage,
-                                         CombinedCompletionAndResultMessage,
-                                         MemoryMessagingProvider, MessageFeed,
-                                         PingMessage)
+                                         MemoryMessagingProvider)
     from openwhisk_tpu.utils.transaction import TransactionId
 
-    def make_action(name, memory=256):
-        a = ExecutableWhiskAction(EntityPath("guest"), EntityName(name),
-                                  CodeExec(kind="python:3", code="x"),
-                                  limits=ActionLimits(TimeLimit(5000),
-                                                      MemoryLimit(MB(memory))))
-        a.rev = DocRevision("1-b")
-        return a
-
-    async def echo_invoker(provider, instance):
-        topic = instance.as_string
-        provider.ensure_topic(topic)
-        consumer = provider.get_consumer(topic, topic)
-        producer = provider.get_producer()
-        box = {}
-
-        async def handle(payload: bytes):
-            msg = ActivationMessage.parse(payload)
-            now = time.time()
-            act = WhiskActivation(
-                EntityPath(str(msg.user.namespace.name)), msg.action.name,
-                msg.user.subject, msg.activation_id, now, now,
-                ActivationResponse.success({"ok": True}), duration=1)
-            await producer.send(
-                f"completed{msg.root_controller_index.as_string}",
-                CombinedCompletionAndResultMessage(msg.transid, act, instance))
-            box["feed"].processed()
-
-        feed = MessageFeed(topic, consumer, 256, handle)
-        box["feed"] = feed
-        feed.start()
-        return feed
+    make_action = _bench_action
 
     async def go() -> dict:
         provider = MemoryMessagingProvider()
         bal = TpuBalancer(provider, ControllerInstanceId("0"),
                           managed_fraction=1.0, blackbox_fraction=0.0)
         await bal.start()
-        feeds = []
-        producer = provider.get_producer()
-        instances = []
-        for i in range(n_invokers):
-            inst = InvokerInstanceId(i, user_memory=MB(8192))
-            instances.append(inst)
-            feeds.append(await echo_invoker(provider, inst))
-            await producer.send("health", PingMessage(inst))
-
-        # keep pinging at 1 Hz for the whole run (as real invokers do) —
-        # supervision marks a fleet Offline after 10 s of silence, which a
-        # cold first compile of the device program can easily outlast
-        stop_ping = asyncio.Event()
-
-        async def pinger():
-            while not stop_ping.is_set():
-                for inst in instances:
-                    await producer.send("health", PingMessage(inst))
-                try:
-                    await asyncio.wait_for(stop_ping.wait(), 1.0)
-                except asyncio.TimeoutError:
-                    pass
-
-        ping_task = asyncio.ensure_future(pinger())
+        feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
         # wait until supervision has actually registered the fleet (a fixed
         # sleep races the first device-program compile on slow channels)
         from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
@@ -296,8 +317,7 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         t0 = time.perf_counter()
         await asyncio.gather(*[one(i) for i in range(total)])
         wall = time.perf_counter() - t0
-        stop_ping.set()
-        await ping_task
+        await stop_fleet()
         await bal.close()
         for f in feeds:
             await f.stop()
@@ -318,6 +338,141 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
             "n_invokers": n_invokers,
             "phases": phases,
             "batch_size_mean": round(bs["mean"], 1) if bs else None,
+        }
+
+    return asyncio.run(go())
+
+
+def _mc_worker(instance: int, cluster_size: int, port: int, total: int,
+               concurrency: int, n_invokers: int) -> None:
+    """Subprocess entry for the multi-controller stage: ONE TpuBalancer
+    (cluster-sharded capacity: each controller gets user_memory/cluster_size
+    per invoker, the reference's getInvokerSlot) publishing over the TCP bus
+    against the parent's shared echo fleet. Protocol: print READY after
+    warmup, wait for GO on stdin, run, print one JSON line."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
+    from openwhisk_tpu.messaging import ActivationMessage
+    from openwhisk_tpu.messaging.tcp import TcpMessagingProvider
+    from openwhisk_tpu.utils.transaction import TransactionId
+
+    async def go():
+        provider = TcpMessagingProvider(port=port)
+        bal = TpuBalancer(provider, ControllerInstanceId(str(instance)),
+                          cluster_size=cluster_size,
+                          managed_fraction=1.0, blackbox_fraction=0.0)
+        await bal.start()
+        for _ in range(240):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= n_invokers:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError(f"worker {instance}: fleet never healthy")
+        actions = [_bench_action(f"mc{instance}_{i}", memory=128)
+                   for i in range(8)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            action = actions[i % len(actions)]
+            msg = ActivationMessage(
+                TransactionId(), action.fully_qualified_name, action.rev.rev,
+                ident, ActivationId.generate(),
+                ControllerInstanceId(str(instance)), True, {})
+            async with sem:
+                promise = await bal.publish(action, msg)
+                await promise
+
+        for _ in range(2):
+            await asyncio.gather(*[one(i) for i in range(min(128, total))])
+        print("READY", flush=True)
+        await asyncio.to_thread(sys.stdin.readline)  # GO
+        t0 = time.time()
+        await asyncio.gather(*[one(i) for i in range(total)])
+        t1 = time.time()
+        await bal.close()
+        print(json.dumps({"instance": instance, "total": total,
+                          "t0": t0, "t1": t1,
+                          "rate": round(total / (t1 - t0), 1)}), flush=True)
+
+    asyncio.run(go())
+
+
+def _multi_controller_bench(n_controllers: int, total_per: int = 1500,
+                            concurrency: int = 64, n_invokers: int = 16
+                            ) -> dict:
+    """Control-plane scale-out: N controller processes (cluster-sharded
+    capacity over one shared echo fleet) publishing concurrently over the
+    TCP bus; reports per-controller and AGGREGATE activations/s. On this
+    one-core box extra controllers can only convert device wire-wait into
+    useful work, so scaling is a lower bound for real multi-host."""
+    import os
+    import socket
+
+    from openwhisk_tpu.messaging.tcp import TcpBusServer, TcpMessagingProvider
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    async def go() -> dict:
+        server = TcpBusServer(port=port)
+        await server.start()
+        # the echo fleet is co-located with the broker: attach it to the
+        # broker's in-process MemoryBus directly (same queues the TCP
+        # workers see) instead of round-tripping localhost TCP into our own
+        # process — co-located components take the in-process fast path
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+        provider = MemoryMessagingProvider()
+        provider.bus = server.bus
+        feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
+        procs = []
+
+        async def read_line(p):
+            line = await p.stdout.readline()
+            return line.decode().strip()
+
+        try:
+            for i in range(n_controllers):
+                code = (f"import bench; bench._mc_worker({i}, "
+                        f"{n_controllers}, {port}, {total_per}, "
+                        f"{concurrency}, {n_invokers})")
+                procs.append(await asyncio.create_subprocess_exec(
+                    sys.executable, "-c", code,
+                    stdin=asyncio.subprocess.PIPE,
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.DEVNULL,
+                    cwd=os.path.dirname(os.path.abspath(__file__))))
+            ready = await asyncio.wait_for(
+                asyncio.gather(*[read_line(p) for p in procs]), timeout=600)
+            if any(r != "READY" for r in ready):
+                raise RuntimeError(f"workers not ready: {ready}")
+            for p in procs:
+                p.stdin.write(b"GO\n")
+                await p.stdin.drain()
+            results = [json.loads(await asyncio.wait_for(read_line(p), 600))
+                       for p in procs]
+        finally:
+            for p in procs:
+                if p.returncode is None:
+                    p.kill()
+                await p.wait()
+            await stop_fleet()
+            for f in feeds:
+                await f.stop()
+            await server.stop()
+
+        wall = max(r["t1"] for r in results) - min(r["t0"] for r in results)
+        return {
+            "n_controllers": n_controllers,
+            "aggregate_activations_per_sec": round(
+                sum(r["total"] for r in results) / wall, 1),
+            "per_controller": [r["rate"] for r in results],
+            "concurrency_per_controller": concurrency,
+            "n_invokers": n_invokers,
         }
 
     return asyncio.run(go())
@@ -454,6 +609,20 @@ def main() -> None:
                 balancer_host = {"backend": "cpu", **host_rows["c64"],
                                  "rows": host_rows}
 
+    multi = None
+    if not args.quick:
+        multi = {}
+        for n in (1, 2, 4):
+            try:
+                multi[f"n{n}"] = _multi_controller_bench(n)
+            except Exception as e:  # noqa: BLE001 — stage is auxiliary
+                print(f"# multi-controller n={n} failed: {e!r}",
+                      file=sys.stderr)
+        if "n1" in multi and "n2" in multi:
+            r1 = multi["n1"]["aggregate_activations_per_sec"]
+            r2 = multi["n2"]["aggregate_activations_per_sec"]
+            multi["scaling_1_to_2"] = round(r2 / r1, 2) if r1 else None
+
     cpu_rate = _cpu_oracle_rate()
     headline = kernels.get("xla") or kernels["pallas"]
     print(f"# device={jax.devices()[0]} backend={jax.default_backend()} "
@@ -475,6 +644,8 @@ def main() -> None:
         out["balancer"] = balancer
     if balancer_host is not None:
         out["balancer_host_path"] = balancer_host
+    if multi:
+        out["multi_controller"] = multi
     print(json.dumps(out))
 
 

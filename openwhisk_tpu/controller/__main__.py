@@ -40,6 +40,11 @@ def main() -> None:
                              "(SURVEY §5.4 checkpoint/resume)")
     parser.add_argument("--balancer-snapshot-interval", type=float,
                         default=10.0)
+    parser.add_argument("--balancer-rate-limit", type=int, default=None,
+                        help="per-namespace activations/minute enforced by "
+                             "the DEVICE token bucket fused into the TPU "
+                             "placement step (bus-boundary backstop behind "
+                             "the front door's entitlement throttle)")
     args = parser.parse_args()
 
     async def run():
@@ -57,7 +62,8 @@ def main() -> None:
                 from .loadbalancer.tpu_balancer import TpuBalancer
                 lb = TpuBalancer(provider, instance, logger=logger,
                                  metrics=logger.metrics,
-                                 cluster_size=args.cluster_size)
+                                 cluster_size=args.cluster_size,
+                                 rate_limit_per_minute=args.balancer_rate_limit)
             else:
                 from .loadbalancer.sharding_balancer import ShardingBalancer
                 lb = ShardingBalancer(provider, instance, logger=logger,

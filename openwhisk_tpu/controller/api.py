@@ -33,7 +33,8 @@ from ..database import DocumentConflict, NoDocumentException
 from ..utils.transaction import TransactionId
 from .entitlement import (ACTIVATE, DELETE, EntitlementException, PUT, READ,
                           RejectRequest)
-from .loadbalancer.base import LoadBalancerException
+from .loadbalancer.base import (LoadBalancerException,
+                                LoadBalancerThrottleException)
 from .invoke import resolve_action
 from .routemgmt import ApiManagementException
 
@@ -156,6 +157,9 @@ class ControllerApi:
                           request.get("transid"))
         except LimitViolation as e:
             return _error(400, str(e), request.get("transid"))
+        except LoadBalancerThrottleException as e:
+            # device rate admission: same surface as an entitlement throttle
+            return _error(429, str(e), request.get("transid"))
         except LoadBalancerException as e:
             return _error(503, str(e), request.get("transid"))
         except (json.JSONDecodeError, ValueError) as e:

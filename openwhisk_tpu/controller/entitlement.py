@@ -13,7 +13,12 @@ RateThrottler.scala + ActivationThrottler.scala:
     the reference's 20% overcommit (:94-99,123-133),
   - kind whitelist (KindRestrictor).
 Device-side note: the vectorized token-bucket equivalent for bulk admission
-lives in openwhisk_tpu/ops/throttle.py and is used by the TPU balancer path.
+lives in openwhisk_tpu/ops/throttle.py; the TPU balancer fuses it into its
+placement step when constructed with rate_limit_per_minute (controller flag
+--balancer-rate-limit) as a bus-boundary backstop behind this front-door
+throttler. Semantics differ deliberately: this class is the reference's
+rolling-minute window with per-user overrides; the device bucket is a
+continuous-refill token bucket at the platform default rate.
 """
 from __future__ import annotations
 

@@ -1,5 +1,6 @@
 from .base import (ActivationEntry, ActiveAckTimeout, CommonLoadBalancer,
                    InvokerHealth, LoadBalancer, LoadBalancerException,
+                   LoadBalancerThrottleException,
                    HEALTHY, UNHEALTHY, UNRESPONSIVE, OFFLINE)
 from .lean import LeanBalancer, LeanBalancerProvider
 from .supervision import InvokerPool

@@ -56,6 +56,11 @@ class LoadBalancerException(Exception):
     pass
 
 
+class LoadBalancerThrottleException(LoadBalancerException):
+    """The balancer's device rate admission rejected the activation (maps
+    to 429 at the API surface, like an entitlement throttle)."""
+
+
 class ActiveAckTimeout(LoadBalancerException):
     def __init__(self, activation_id: ActivationId):
         super().__init__(f"no completion or active ack received yet for {activation_id}")

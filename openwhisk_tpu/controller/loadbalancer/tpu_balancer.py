@@ -46,11 +46,13 @@ from ...messaging.message import ActivationMessage
 from ...models.sharding_policy import (MIN_SLOT_MB, generate_hash,
                                        pairwise_coprimes)
 from ...ops.placement import (PlacementState, RequestBatch, init_state,
+                              make_fused_admit_step_packed,
                               make_fused_step_packed, make_release_packed,
                               release_batch, schedule_batch, set_health,
                               unpack_chosen)
+from ...ops.throttle import init_buckets
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
-                   LoadBalancerException)
+                   LoadBalancerException, LoadBalancerThrottleException)
 from .supervision import InvokerPool
 
 
@@ -162,7 +164,8 @@ class TpuBalancer(CommonLoadBalancer):
                  batch_window: float = 0.002, max_batch: int = 256,
                  action_slots: int = 4096, max_action_slots: int = 65536,
                  initial_pad: int = 64, mesh=None, kernel: str = "xla",
-                 pipeline_depth: int = 4):
+                 pipeline_depth: int = 4,
+                 rate_limit_per_minute: Optional[int] = None):
         super().__init__(messaging_provider, controller_instance, logger, metrics)
         self._cluster_size = cluster_size
         self.kernel = kernel  # "xla" | "pallas" (single-device only)
@@ -173,6 +176,16 @@ class TpuBalancer(CommonLoadBalancer):
         self.action_slots = action_slots
         self.max_action_slots = max(max_action_slots, action_slots)
         self.mesh = mesh
+        #: opt-in bulk ACTIVATE admission ON DEVICE (ops.throttle token
+        #: buckets fused into the placement step): per-namespace platform
+        #: rate as a bus-boundary backstop. The HTTP front door's
+        #: entitlement RateThrottler (with per-user overrides) remains the
+        #: primary enforcement; this catches traffic that bypasses it
+        #: (direct bus publishers, misconfigured edges).
+        self.rate_limit_per_minute = rate_limit_per_minute
+        self._ns_slots: Dict[str, int] = {}
+        self._bucket_state = None
+        self._t0_mono = time.monotonic()
         self._n_pad = max(initial_pad, (mesh and np.prod(list(mesh.shape.values()))) or 1)
 
         self._registry: List[InvokerInstanceId] = []
@@ -258,18 +271,39 @@ class TpuBalancer(CommonLoadBalancer):
         # release + health-fold + schedule as ONE compiled program (vs
         # three dispatches per micro-batch), fed through the transfer-packed
         # wrappers (3 host->device transfers per step instead of 16)
-        self._packed_fn = make_fused_step_packed(self._release_fn,
-                                                 self._sched_fn)
+        self._build_packed_fns()
+
+    def _build_packed_fns(self) -> None:
+        if self.rate_limit_per_minute is not None:
+            self._packed_fn = make_fused_admit_step_packed(self._release_fn,
+                                                           self._sched_fn)
+            # bucket state is SOFT (a rolling rate window): re-initialized
+            # full on (re)build/restore rather than checkpointed
+            self._bucket_state = init_buckets(self.RATE_NS_BUCKETS,
+                                              self.rate_limit_per_minute)
+        else:
+            self._packed_fn = make_fused_step_packed(self._release_fn,
+                                                     self._sched_fn)
         self._release_packed_fn = make_release_packed(self._release_fn)
+
+    def _ns_slot(self, ns_id: str) -> int:
+        slot = self._ns_slots.get(ns_id)
+        if slot is None:
+            if len(self._ns_slots) < self.RATE_NS_BUCKETS:
+                # dedicated slot — memoized (bounds the dict at the axis)
+                slot = len(self._ns_slots)
+                self._ns_slots[ns_id] = slot
+            else:  # axis full: stable shared slot (conflated rate), NOT
+                # memoized — crc32 is cheaper than unbounded dict growth
+                slot = zlib.crc32(ns_id.encode()) % self.RATE_NS_BUCKETS
+        return slot
 
     def _use_xla_kernels(self) -> None:
         """Swap the XLA schedule/release kernels in (pallas state outgrew
         the VMEM budget, via growth or snapshot restore)."""
         self._sched_fn = schedule_batch
         self._release_fn = release_batch
-        self._packed_fn = make_fused_step_packed(self._release_fn,
-                                                 self._sched_fn)
-        self._release_packed_fn = make_release_packed(self._release_fn)
+        self._build_packed_fns()
 
     def _pallas_fits(self) -> bool:
         from ...ops.placement_pallas import fits_vmem
@@ -440,9 +474,12 @@ class TpuBalancer(CommonLoadBalancer):
         # request row in packed-matrix order (see _dispatch_batch): a plain
         # tuple converts to the int32 batch matrix in one C-speed np.array
         # call instead of a per-field Python fill loop
+        ns_slot = (self._ns_slot(msg.user.namespace.uuid.asString)
+                   if self.rate_limit_per_minute is not None else 0)
         req = (offset, size, h % size, _mod_inverse(step, size), mem,
                self._slots.acquire(slot_key), maxc,
-               (h ^ (self._rand_counter * 2654435761)) % max(size, 1), 1)
+               (h ^ (self._rand_counter * 2654435761)) % max(size, 1), 1,
+               ns_slot)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending.append((req, fut, slot_key))
         # inline fast path: with free pipeline capacity, dispatch NOW
@@ -466,6 +503,13 @@ class TpuBalancer(CommonLoadBalancer):
             if fut.done() and not fut.cancelled() and fut.exception() is None:
                 self._abandon_placement(int(fut.result()[0]), req, slot_key)
             raise
+        if inv_idx == -2:
+            # device token bucket rejected it: no capacity was consumed
+            self._slots.release(slot_key, req[self.R_CONC_SLOT])
+            self.metrics.counter("loadbalancer_device_throttled")
+            raise LoadBalancerThrottleException(
+                "Too many requests in the last minute (device rate "
+                "admission).")
         if inv_idx < 0:
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
             raise LoadBalancerException(
@@ -599,6 +643,10 @@ class TpuBalancer(CommonLoadBalancer):
     #: request-tuple field indices (row order of the packed matrix)
     R_NEED_MB, R_CONC_SLOT, R_MAX_CONC = 4, 5, 6
 
+    #: namespace-bucket axis for device rate admission (conflates via CRC32
+    #: past this many distinct namespaces)
+    RATE_NS_BUCKETS = 1024
+
     #: health updates drained per device step — a FIXED batch shape, so the
     #: fused program's compile-cache keys vary only in (release, batch)
     #: buckets; leftovers roll to the next step (fleet churn is slow vs the
@@ -697,10 +745,13 @@ class TpuBalancer(CommonLoadBalancer):
         # already in row order, so one C-speed np.array call fills it.
         # Padded request columns keep size=1/max_conc=1 like the old
         # pad_req dict
-        req_np = np.zeros((9, bp), np.int32)
+        rate_on = self.rate_limit_per_minute is not None
+        rows = 10 if rate_on else 9
+        req_np = np.zeros((rows, bp), np.int32)
         req_np[1, b:] = 1  # size
         req_np[6, b:] = 1  # max_conc
-        req_np[:, :b] = np.array([r for r, _, _ in batch], np.int32).T
+        req_np[:, :b] = np.array(
+            [r[:rows] for r, _, _ in batch], np.int32).T
         rel_np = self._release_packed()
         health_np = self._health_packed()
         # releases + health flips + schedule: ONE device program over ONE
@@ -713,8 +764,14 @@ class TpuBalancer(CommonLoadBalancer):
                               req_np.ravel()])
         t_assembled = time.monotonic()
         try:
-            self.state, out = self._packed_fn(
-                self.state, buf, rel_np.shape[1], health_np.shape[1], bp)
+            if rate_on:
+                (self.state, self._bucket_state), out = self._packed_fn(
+                    (self.state, self._bucket_state), buf,
+                    np.float32(time.monotonic() - self._t0_mono),
+                    rel_np.shape[1], health_np.shape[1], bp)
+            else:
+                self.state, out = self._packed_fn(
+                    self.state, buf, rel_np.shape[1], health_np.shape[1], bp)
         except Exception as e:  # noqa: BLE001 — a failed dispatch must not
             # leak the permit, the host-side conc slots, or strand the
             # publishers (device capacity from the drained releases is
@@ -753,7 +810,7 @@ class TpuBalancer(CommonLoadBalancer):
     def _read_back(self, out):
         """Device->host conversion seam (runs on the worker thread);
         a separate method so tests can inject readback failures."""
-        return unpack_chosen(np.asarray(out))
+        return unpack_chosen(np.asarray(out))  # (chosen, forced, throttled)
 
     async def _readback_step(self, batch, b, out, t0, req_np) -> None:
         # the step-duration stamp is taken ON the worker thread so the
@@ -769,7 +826,8 @@ class TpuBalancer(CommonLoadBalancer):
             return arrs, t_r1
 
         try:
-            (chosen_np, forced_np), t_done = await asyncio.to_thread(_read)
+            (chosen_np, forced_np, throttled_np), t_done = \
+                await asyncio.to_thread(_read)
         except Exception as e:  # noqa: BLE001 — publishers must not hang,
             # and their host-side conc slots must not leak. The DISPATCH
             # succeeded (only the host conversion failed), so the device
@@ -779,7 +837,7 @@ class TpuBalancer(CommonLoadBalancer):
             # the schedule fold acquired (release_batch is its inverse).
             compensated = True
             try:
-                chosen, _ = unpack_chosen(out)
+                chosen, _, _ = unpack_chosen(out)
                 rel = jnp.stack([
                     jnp.maximum(chosen, 0).astype(jnp.int32),
                     jnp.asarray(req_np[5]), jnp.asarray(req_np[4]),
@@ -812,15 +870,16 @@ class TpuBalancer(CommonLoadBalancer):
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)
         t_f0 = time.monotonic()
-        for (req, fut, slot_key), inv_idx, f in zip(batch, chosen_np,
-                                                    forced_np):
+        for (req, fut, slot_key), inv_idx, f, thr in zip(
+                batch, chosen_np, forced_np, throttled_np):
             if fut.cancelled():
                 # abandoned publisher (client disconnected while awaiting
                 # placement): nobody will ever ack this activation, so give
-                # back what the schedule fold reserved for it
+                # back what the schedule fold reserved for it (throttled
+                # requests carry chosen == -1: nothing was reserved)
                 self._abandon_placement(int(inv_idx), req, slot_key)
             elif not fut.done():
-                fut.set_result((int(inv_idx), bool(f)))
+                fut.set_result((-2 if thr else int(inv_idx), bool(f)))
         self.metrics.histogram("loadbalancer_tpu_fanout_ms",
                                (time.monotonic() - t_f0) * 1e3)
 

@@ -229,10 +229,11 @@ def make_fused_step_packed(release_fn=None, schedule_fn=None):
     back; on a tunneled device every transfer is a round trip, so the
     TRANSFER COUNT — not the kernel — dominates the step. Packing collapses
     the inputs to ONE flat int32 buffer (rel [5*R] ++ health [3*H] ++ req
-    [9*B], split by static shape inside the program) and the outputs to ONE
-    int32 vector (((chosen+1)<<1)|forced — callers decode with
-    `unpack_chosen`). R/H/B are static per compile; the balancer's
-    power-of-two bucketing bounds the cache-key count.
+    [9*B] here, [10*B] in the admit variant; split by static shape inside
+    the program) and the outputs to ONE int32 vector
+    (((chosen+1)<<2) | throttled<<1 | forced — always 0 for throttled here;
+    callers decode with `unpack_chosen`). R/H/B are static per compile; the
+    balancer's power-of-two bucketing bounds the cache-key count.
     """
     fused = make_fused_step(release_fn, schedule_fn)
 
@@ -251,12 +252,48 @@ def make_fused_step_packed(release_fn=None, schedule_fn=None):
         state, chosen, forced = fused(
             state, rel[0], rel[1], rel[2], rel[3], rel[4].astype(bool),
             health[0], health[1].astype(bool), health[2].astype(bool), batch)
-        return state, ((chosen + 1) << 1) | forced.astype(jnp.int32)
+        return state, ((chosen + 1) << 2) | forced.astype(jnp.int32)
+
+    return packed
+
+
+def make_fused_admit_step_packed(release_fn=None, schedule_fn=None):
+    """make_fused_step_packed + device token-bucket admission (ops.throttle):
+    the fused program folds releases and health, ADMITS the batch against
+    per-namespace buckets (Entitlement.scala:86-153 / RateThrottler.scala as
+    a vectorized segmented count — see ops/throttle.py), then schedules only
+    the admitted requests. Over-rate requests come back flagged in bit 1 of
+    the packed output and never consume placement capacity.
+
+    req grows a 10th row: ns_slot (the balancer's namespace->bucket index).
+    """
+    from .throttle import admit_batch
+
+    fused = make_fused_step(release_fn, schedule_fn)
+
+    @partial(jax.jit, static_argnums=(3, 4, 5))
+    def packed(carry, buf, now, R: int, H: int, B: int):
+        state, buckets = carry
+        rel = buf[:5 * R].reshape(5, R)
+        health = buf[5 * R:5 * R + 3 * H].reshape(3, H)
+        req = buf[5 * R + 3 * H:].reshape(10, B)
+        valid = req[8].astype(bool)
+        buckets, admitted = admit_batch(buckets, now, req[9], valid)
+        throttled = valid & ~admitted
+        batch = RequestBatch(req[0], req[1], req[2], req[3], req[4], req[5],
+                             req[6], req[7], admitted)
+        state, chosen, forced = fused(
+            state, rel[0], rel[1], rel[2], rel[3], rel[4].astype(bool),
+            health[0], health[1].astype(bool), health[2].astype(bool), batch)
+        out = (((chosen + 1) << 2) | (throttled.astype(jnp.int32) << 1)
+               | forced.astype(jnp.int32))
+        return (state, buckets), out
 
     return packed
 
 
 def unpack_chosen(out):
-    """Decode make_fused_step_packed's packed output vector (host numpy or
-    device jnp): -> (chosen int32, forced bool)."""
-    return (out >> 1) - 1, (out & 1).astype(bool)
+    """Decode the packed step output vector (host numpy or device jnp):
+    -> (chosen int32, forced bool, throttled bool). Throttled requests
+    carry chosen == -1 (they were never scheduled)."""
+    return (out >> 2) - 1, (out & 1).astype(bool), ((out >> 1) & 1).astype(bool)

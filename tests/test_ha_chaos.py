@@ -387,29 +387,37 @@ class TestThrottlesOverHttp:
 @pytest.mark.slow
 class TestTpuBalancerDistributed:
     def test_tpu_balancer_multi_process(self, tmp_path):
-        """The TPU placement path in true distributed mode: controller with
-        the device kernel balancer as its own OS process, invoker + bus
-        beside it, blocking invokes over HTTP. (Subprocesses pin JAX to the
-        CPU backend so tests never contend for the tunneled chip.)"""
+        """The TPU placement path in true distributed mode: TWO controller
+        processes, each with its own device-kernel balancer and a cluster-
+        sharded half of the fleet's capacity, publishing interleaved onto
+        the SAME shared invoker (bus + invoker beside them as their own OS
+        processes). (Subprocesses pin JAX to the CPU backend so tests never
+        contend for the tunneled chip.)"""
         env = {"JAX_PLATFORMS": "cpu"}
-        cluster = Cluster(tmp_path, n_controllers=1, balancer="tpu",
+        cluster = Cluster(tmp_path, n_controllers=2, balancer="tpu",
                           ctrl_env=env)
         cluster.start()
         try:
             async def drive():
                 async with aiohttp.ClientSession() as s:
                     assert await cluster.wait_healthy(s, timeout=120)
-                    base = cluster.api()
-                    async with s.put(f"{base}/namespaces/_/actions/tdist",
+                    assert await cluster.wait_healthy(
+                        s, port=cluster.ctrl_ports[1], timeout=120)
+                    base0 = cluster.api(cluster.ctrl_ports[0])
+                    base1 = cluster.api(cluster.ctrl_ports[1])
+                    async with s.put(f"{base0}/namespaces/_/actions/tdist",
                                      headers=HDRS,
                                      json={"exec": {"kind": "python:3",
                                                     "code": CODE}}) as r:
                         assert r.status == 200, await r.text()
+                    # interleave: both controllers place concurrently on the
+                    # one shared invoker (each owns half its capacity)
                     results = await asyncio.gather(*[
-                        s.post(f"{base}/namespaces/_/actions/tdist"
+                        s.post(f"{base0 if i % 2 == 0 else base1}"
+                               "/namespaces/_/actions/tdist"
                                "?blocking=true&result=true",
                                headers=HDRS, json={"n": i}).__aenter__()
-                        for i in range(6)])
+                        for i in range(8)])
                     out = []
                     for r in results:
                         out.append((r.status, await r.json()))
@@ -418,7 +426,51 @@ class TestTpuBalancerDistributed:
 
             out = asyncio.run(drive())
             assert all(st == 200 and body["alive"] for st, body in out), out
-            assert sorted(body["n"] for _, body in out) == list(range(6))
+            assert sorted(body["n"] for _, body in out) == list(range(8))
+            # both controllers' placements executed (even n via controller0,
+            # odd via controller1 — all landed on the single shared invoker)
+            evens = [body["n"] for st, body in out if body["n"] % 2 == 0]
+            odds = [body["n"] for st, body in out if body["n"] % 2 == 1]
+            assert len(evens) == 4 and len(odds) == 4
+        finally:
+            cluster.stop()
+
+
+@pytest.mark.slow
+class TestDeviceRateLimitOverHttp:
+    def test_balancer_rate_limit_flag_returns_429(self, tmp_path):
+        """--balancer-rate-limit wires ops/throttle.py's device token bucket
+        into the TPU placement step: past the per-namespace budget, blocking
+        invokes surface as 429 at the REST API (entitlement-throttle shape),
+        while the front-door RateThrottler (default 60/min) never fires."""
+        cluster = Cluster(tmp_path, n_controllers=1, balancer="tpu",
+                          ctrl_env={"JAX_PLATFORMS": "cpu"})
+        cluster.ctrl_extra_argv = ["--balancer-rate-limit", "2"]
+        cluster.start()
+        try:
+            async def drive():
+                async with aiohttp.ClientSession() as s:
+                    assert await cluster.wait_healthy(s, timeout=120)
+                    base = cluster.api()
+                    async with s.put(f"{base}/namespaces/_/actions/dev429",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": CODE}}) as r:
+                        assert r.status == 200
+                    statuses = []
+                    for _ in range(4):
+                        async with s.post(
+                                f"{base}/namespaces/_/actions/dev429"
+                                "?blocking=true",
+                                headers=HDRS, json={}) as r:
+                            statuses.append(r.status)
+                            body = await r.json()
+                    return statuses, body
+
+            statuses, last_body = asyncio.run(drive())
+            assert statuses[:2] == [200, 200], statuses
+            assert 429 in statuses[2:], statuses
+            assert "error" in last_body
         finally:
             cluster.stop()
 
