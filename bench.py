@@ -624,8 +624,18 @@ def main() -> None:
             multi["scaling_1_to_2"] = round(r2 / r1, 2) if r1 else None
 
     cpu_rate = _cpu_oracle_rate()
-    headline = kernels.get("xla") or kernels["pallas"]
+    # the headline is what the product's kernel="auto" policy resolves to
+    # at THIS stage's geometry (fleet padded to a power of two, 256 action
+    # slots) — the same resolver TpuBalancer uses, not a re-implementation;
+    # both kernel rows ride along in `kernels`
+    from openwhisk_tpu.controller.loadbalancer.tpu_balancer import (
+        _next_pow2, resolve_auto_kernel)
+    default_kernel = resolve_auto_kernel(_next_pow2(args.fleet), 256)
+    if default_kernel not in kernels:
+        default_kernel = "xla" if "xla" in kernels else "pallas"
+    headline = kernels.get(default_kernel) or next(iter(kernels.values()))
     print(f"# device={jax.devices()[0]} backend={jax.default_backend()} "
+          f"kernel={default_kernel} "
           f"p50_step={headline['p50_step_ms']:.2f}ms "
           f"cpu_oracle={cpu_rate:.0f}/s parity={parity_ok}", file=sys.stderr)
 
@@ -636,6 +646,16 @@ def main() -> None:
         "vs_baseline": round(headline["rate_median"] / TARGET, 3),
         "median_of": headline["repeats"],
         "spread_pct": headline["spread_pct"],
+        "kernel_selection": {
+            "default": default_kernel,
+            "policy": "kernel='auto' (TpuBalancer.resolve_auto_kernel): "
+                      "pallas on TPU while the state fits VMEM, else xla "
+                      "(large fleets swap to xla on growth)",
+            "geometry": {"n_pad": _next_pow2(args.fleet),
+                         "action_slots": 256},
+            "rationale": "equal median rate at bit-exact parity; pallas "
+                         "spread 12-18% vs xla 58-69% across r04-r05 runs",
+        },
         "kernels": kernels,
         "parity_ok": parity_ok,
         "cpu_oracle_per_sec": round(cpu_rate, 1),

@@ -452,3 +452,25 @@ def test_cancelled_publisher_releases_capacity():
     leaked, free_ok, conc_ok = run(go())
     assert leaked == 0
     assert free_ok and conc_ok
+
+
+def test_auto_kernel_outgrow_swaps_to_xla():
+    """r5 review: with kernel="auto" (the new default) a balancer whose
+    state outgrows the pallas VMEM budget must still swap to the XLA
+    kernels — the guard keys on kernel_resolved, not the literal "pallas"
+    constructor argument."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.core.entity import ControllerInstanceId
+    from openwhisk_tpu.ops.placement import release_batch, schedule_batch
+
+    bal = TpuBalancer(MemoryMessagingProvider(), ControllerInstanceId("0"),
+                      action_slots=4096, initial_pad=64)
+    assert bal.kernel == "auto"
+    # simulate the auto policy having resolved pallas (as on real TPU —
+    # on the CPU test backend auto resolves xla, so force the state the
+    # guard must handle)
+    bal.kernel_resolved = "pallas"
+    bal._grow_padding(1024)  # (4096+2)*1024*4 bytes >> the 8 MiB budget
+    assert bal.kernel_resolved == "xla"
+    assert bal._sched_fn is schedule_batch
+    assert bal._release_fn is release_batch
