@@ -15,7 +15,7 @@ import asyncio
 
 from ..core.entity import ControllerInstanceId, ExecManifest, WhiskAuthRecord
 from ..database import open_store
-from ..messaging.tcp import TcpMessagingProvider
+from ..messaging import provider_for_bus
 from ..utils.config import config_from_env, honor_jax_platforms_env
 from ..utils.logging import Logging
 from .core import Controller
@@ -54,8 +54,7 @@ def main() -> None:
         controller = snapshotter = None
         try:
             ExecManifest.initialize()
-            host, _, port = args.bus.partition(":")
-            provider = TcpMessagingProvider(host, int(port or 4222))
+            provider = provider_for_bus(args.bus)
             store = open_store(args.db)
             instance = ControllerInstanceId(args.instance)
             if args.balancer == "tpu":

@@ -81,6 +81,8 @@ class ControllerApi:
         r.add_get("/ping", self.ping)
         r.add_get("/api/v1", self.api_info)
         r.add_get("/api/v1/api-docs", self.api_docs)
+        r.add_get("/api/v1/api-docs/ui", self.api_docs_ui)
+        r.add_get("/docs", self.docs_redirect)
         r.add_get("/api/v1/namespaces", self.list_namespaces)
         base = "/api/v1/namespaces/{ns}"
         # actions (name may contain a package segment)
@@ -130,8 +132,8 @@ class ControllerApi:
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
-        if request.path in ("/ping", "/api/v1", "/metrics",
-                            "/api/v1/api-docs") or \
+        if request.path in ("/ping", "/api/v1", "/metrics", "/docs",
+                            "/api/v1/api-docs", "/api/v1/api-docs/ui") or \
                 request.path.startswith("/api/v1/web/") or \
                 request.path in self.c.public_extra_paths:
             return await handler(request)
@@ -292,6 +294,18 @@ class ControllerApi:
             "paths": paths,
         }
         return web.json_response(ControllerApi._api_docs_cache)
+
+    async def docs_redirect(self, request):
+        """`/docs` -> the swagger UI (ref RestAPIs.scala:50-81, where the
+        reference redirects to its bundled swagger-ui)."""
+        raise web.HTTPFound("/api/v1/api-docs/ui")
+
+    async def api_docs_ui(self, request):
+        """The operator-visible half of the swagger surface: a
+        SELF-CONTAINED API explorer (no CDN assets — this must render in
+        air-gapped deployments) that fetches /api/v1/api-docs and lays the
+        paths out with methods, parameters and response codes."""
+        return web.Response(text=_SWAGGER_UI_HTML, content_type="text/html")
 
     async def invokers(self, request):
         health = await self.c.load_balancer.invoker_health()
@@ -895,3 +909,75 @@ class ControllerApi:
         (ref WebActions.scala:375-460): /api/v1/web/{ns}/{pkg}/{name}.{ext};
         pkg 'default' means no package."""
         return await self.c.web_actions.handle(request)
+
+
+_SWAGGER_UI_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>OpenWhisk-TPU API</title>
+<style>
+  body { font-family: -apple-system, 'Segoe UI', sans-serif; margin: 0;
+         background: #fafafa; color: #1a1a1a; }
+  header { background: #14334d; color: #fff; padding: 14px 24px; }
+  header h1 { margin: 0; font-size: 18px; font-weight: 600; }
+  header a { color: #9cc7e8; font-size: 13px; text-decoration: none; }
+  main { max-width: 960px; margin: 18px auto; padding: 0 16px; }
+  .path { background: #fff; border: 1px solid #e2e2e2; border-radius: 6px;
+          margin-bottom: 8px; overflow: hidden; }
+  .path > summary { padding: 8px 12px; cursor: pointer; font-family: ui-monospace, monospace;
+          font-size: 13px; display: flex; gap: 8px; align-items: center; flex-wrap: wrap; }
+  .op { border-top: 1px solid #eee; padding: 8px 12px 10px; font-size: 13px; }
+  .verb { display: inline-block; min-width: 52px; text-align: center;
+          border-radius: 3px; color: #fff; font-size: 11px; font-weight: 700;
+          padding: 2px 6px; text-transform: uppercase; }
+  .get { background: #2f81b7; } .post { background: #3f9c5f; }
+  .put { background: #c78a28; } .delete { background: #c0392b; }
+  .patch { background: #7b5ea7; } .head { background: #6a7a86; }
+  .summary { color: #444; }
+  table { border-collapse: collapse; margin-top: 6px; }
+  td, th { border: 1px solid #e8e8e8; padding: 3px 8px; font-size: 12px; text-align: left; }
+  code { background: #f0f3f5; padding: 1px 4px; border-radius: 3px; font-size: 12px; }
+</style></head><body>
+<header><h1>OpenWhisk-TPU REST API</h1>
+<a href="/api/v1/api-docs">raw swagger 2.0 JSON</a></header>
+<main id="m">loading /api/v1/api-docs…</main>
+<script>
+fetch('/api/v1/api-docs').then(r => r.json()).then(doc => {
+  const m = document.getElementById('m'); m.textContent = '';
+  const h = document.createElement('p');
+  h.innerHTML = '<b>' + doc.info.title + '</b> v' + doc.info.version +
+    ' — swagger ' + doc.swagger;
+  m.appendChild(h);
+  for (const [path, ops] of Object.entries(doc.paths)) {
+    const d = document.createElement('details'); d.className = 'path';
+    const s = document.createElement('summary');
+    let badges = '';
+    for (const verb of Object.keys(ops))
+      badges += '<span class="verb ' + verb + '">' + verb + '</span>';
+    s.innerHTML = badges + ' <span>' + path + '</span>';
+    d.appendChild(s);
+    for (const [verb, op] of Object.entries(ops)) {
+      const o = document.createElement('div'); o.className = 'op';
+      let html = '<span class="verb ' + verb + '">' + verb + '</span> ' +
+                 '<span class="summary">' + (op.summary || '') + '</span>';
+      if (op.parameters && op.parameters.length) {
+        html += '<table><tr><th>query param</th><th>type</th></tr>';
+        for (const p of op.parameters)
+          html += '<tr><td><code>' + p.name + '</code></td><td>' +
+                  (p.type || '') + '</td></tr>';
+        html += '</table>';
+      }
+      if (op.responses) {
+        html += '<table><tr><th>status</th><th>meaning</th></tr>';
+        for (const [code, r] of Object.entries(op.responses))
+          html += '<tr><td>' + code + '</td><td>' + (r.description || '') +
+                  '</td></tr>';
+        html += '</table>';
+      }
+      o.innerHTML = html;
+      d.appendChild(o);
+    }
+    m.appendChild(d);
+  }
+}).catch(e => { document.getElementById('m').textContent =
+  'failed to load api-docs: ' + e; });
+</script></body></html>
+"""

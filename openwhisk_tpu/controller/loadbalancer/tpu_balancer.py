@@ -290,6 +290,10 @@ class TpuBalancer(CommonLoadBalancer):
             self.state = state
             self._sched_fn = schedule_batch
             self._release_fn = release_batch
+            if self.kernel_resolved == "pallas":
+                # explicit kernel="pallas" that failed the VMEM fit:
+                # report what actually runs
+                self.kernel_resolved = "xla"
         # release + health-fold + schedule as ONE compiled program (vs
         # three dispatches per micro-batch), fed through the transfer-packed
         # wrappers (3 host->device transfers per step instead of 16)

@@ -90,7 +90,7 @@ def main() -> None:
 
     from aiohttp import web
 
-    from ..messaging.tcp import TcpMessagingProvider
+    from ..messaging import provider_for_bus
 
     parser = argparse.ArgumentParser(description="user-events monitoring")
     parser.add_argument("--bus", default="127.0.0.1:4222")
@@ -98,8 +98,7 @@ def main() -> None:
     args = parser.parse_args()
 
     async def run():
-        host, _, port = args.bus.partition(":")
-        provider = TcpMessagingProvider(host, int(port or 4222))
+        provider = provider_for_bus(args.bus)
         recorder = UserEventsRecorder(provider)
         recorder.start()
 

@@ -16,7 +16,7 @@ from ..containerpool import ContainerPoolConfig
 from ..containerpool.factory import FACTORY_PROVIDERS
 from ..core.entity import ExecManifest, InvokerInstanceId, MB
 from ..database import ArtifactActivationStore, EntityStore, open_store
-from ..messaging.tcp import TcpMessagingProvider
+from ..messaging import provider_for_bus
 from ..utils.logging import Logging
 from .id_assigner import InstanceIdAssigner
 from .reactive import InvokerReactive
@@ -50,8 +50,7 @@ def main() -> None:
         invoker = server = None
         try:
             ExecManifest.initialize()
-            host, _, port = args.bus.partition(":")
-            provider = TcpMessagingProvider(host, int(port or 4222))
+            provider = provider_for_bus(args.bus)
             store = open_store(args.db)
             instance_id = await InstanceIdAssigner(store).assign(
                 args.unique_name, args.id)

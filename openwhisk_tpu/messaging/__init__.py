@@ -5,4 +5,29 @@ from .message import (AcknowledgementMessage, ActivationMessage,
 from .connector import MessageConsumer, MessageFeed, MessageProducer, MessagingProvider
 from .memory import MemoryMessagingProvider
 
+
+def provider_for_bus(bus_addr: str) -> MessagingProvider:
+    """Messaging bootstrap for the service mains (controller, invoker,
+    monitoring): any MessagingProvider SPI override wins — an explicit
+    `spi.bind()` (embedding/tests) or
+    `CONFIG_whisk_spi_MessagingProvider=openwhisk_tpu.messaging.kafka:KafkaMessagingProvider`
+    — with `--bus` handed to the implementation as its bootstrap address
+    (Kafka: bootstrap servers; TCP: split host:port). Default: the
+    built-in TCP bus at `--bus host:port`."""
+    from .tcp import TcpMessagingProvider
+    from .. import spi
+    host, _, port = bus_addr.partition(":")
+    if spi.overridden("MessagingProvider"):
+        impl = spi.get("MessagingProvider")
+        if isinstance(impl, MessagingProvider):
+            return impl  # bound instance
+        if isinstance(impl, type) and issubclass(impl, TcpMessagingProvider):
+            return impl(host, int(port or 4222))
+        try:
+            return impl(bus_addr)
+        except TypeError:  # providers without a bootstrap argument
+            return impl()
+    return TcpMessagingProvider(host, int(port or 4222))
+
+
 __all__ = [n for n in dir() if not n.startswith("_")]

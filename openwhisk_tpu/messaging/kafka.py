@@ -88,10 +88,14 @@ class KafkaConsumer(MessageConsumer):
                 out.append((r.topic, r.partition, r.offset, r.value))
         return out
 
-    def commit(self) -> None:
+    def commit(self):
+        """Fire-and-forget offset commit (the base contract); returns the
+        spawned task so callers needing commit-before-handoff ordering
+        (e.g. the integration suite) can await it."""
         if self._started:
             from ..utils.tasks import spawn
-            spawn(self._consumer.commit(), name="kafka-commit")
+            return spawn(self._consumer.commit(), name="kafka-commit")
+        return None
 
     async def close(self) -> None:
         if self._started:
@@ -112,12 +116,14 @@ class KafkaMessagingProvider(MessagingProvider):
                              from_latest=from_latest)
 
     def ensure_topic(self, topic: str, partitions: int = 1,
-                     retention_bytes: Optional[int] = None) -> None:
+                     retention_bytes: Optional[int] = None):
         """Best-effort topic creation with retention.bytes (the reference
         creates topics with per-topic retention configs,
         KafkaMessagingProvider.ensureTopic). Falls back to broker
         auto-create when no admin client is importable or the broker
-        rejects the call — retention is then operator-managed."""
+        rejects the call — retention is then operator-managed. Returns the
+        spawned admin task (or None) so callers that need create-before-
+        produce ordering can await it; the base contract ignores it."""
         from ..utils.tasks import spawn
         try:
             from aiokafka.admin import (  # type: ignore[import-not-found]
@@ -143,6 +149,7 @@ class KafkaMessagingProvider(MessagingProvider):
         try:
             import asyncio
             if asyncio.get_event_loop().is_running():
-                spawn(create(), name=f"kafka-ensure-{topic}")
+                return spawn(create(), name=f"kafka-ensure-{topic}")
         except RuntimeError:
             pass
+        return None

@@ -54,6 +54,13 @@ class SpiResolutionError(Exception):
     pass
 
 
+def overridden(name: str) -> bool:
+    """True when `name` resolves to something other than the library
+    default — an explicit bind() or the CONFIG_whisk_spi_<Name> env var."""
+    return name in _bindings or \
+        bool(os.environ.get(f"CONFIG_whisk_spi_{name}"))
+
+
 def bind(name: str, impl: Any) -> None:
     """Explicitly bind an SPI to an implementation (object or 'mod:attr')."""
     _bindings[name] = impl

@@ -1,12 +1,24 @@
-"""Kafka backend contract tests against a fake aiokafka client (ref
-connector/kafka/*.scala + KafkaConnectorTests.scala): topic ensure with
-retention config, commit-after-peek at-most-once handoff, payload-size
-config, from-latest subscription, and the MessageFeed pipeline running on
-top. The real `aiokafka` is not in this image, so the fake stands in —
-these tests are the first execution this backend gets anywhere.
+"""Kafka backend contract tests (ref connector/kafka/*.scala +
+KafkaConnectorTests.scala): topic ensure with retention config,
+commit-after-peek at-most-once handoff, payload-size config, from-latest
+subscription, and the MessageFeed pipeline running on top.
 
-When no fake is installed the module stays import-gated: constructing any
-Kafka class raises the clear RuntimeError instead of an obscure NameError.
+Two execution modes:
+  - default: against the fake aiokafka client below (the real `aiokafka`
+    is not in this image). Every fake method carries a citation to the
+    real client's documented behavior (aiokafka.readthedocs.io, API
+    section) so the assumptions it encodes are reviewable one by one.
+  - `pytest -m kafka`: TestKafkaIntegration runs the same behavioral
+    contract against the REAL aiokafka client and a REAL broker — it
+    activates when `aiokafka` is importable and
+    OPENWHISK_TPU_KAFKA_BOOTSTRAP points at a broker (see
+    docs/reference.md "Kafka backend" runbook). One `pip install
+    aiokafka` + a broker URL away from a genuine execution, matching the
+    reference's KafkaConnectorTests.scala:1 smoke test.
+
+When no client is installed the module stays import-gated: constructing
+any Kafka class raises the clear RuntimeError instead of an obscure
+NameError.
 """
 import asyncio
 import importlib
@@ -49,6 +61,11 @@ def make_fake_aiokafka(broker: FakeBroker):
             self.started = False
 
         async def send_and_wait(self, topic, value):
+            # aiokafka API: AIOKafkaProducer.send_and_wait(topic, value=…)
+            # publishes and awaits the broker ack; raises
+            # kafka.errors.MessageSizeTooLargeError when the serialized
+            # message exceeds max_request_size; calling before start()
+            # raises ProducerClosed/IllegalOperation
             assert self.started, "send before start()"
             if self.max_request_size and len(value) > self.max_request_size:
                 raise RuntimeError("MessageSizeTooLargeError")
@@ -66,6 +83,11 @@ def make_fake_aiokafka(broker: FakeBroker):
     class AIOKafkaConsumer:
         def __init__(self, topic, bootstrap_servers=None, group_id=None,
                      enable_auto_commit=None, auto_offset_reset="earliest"):
+            # aiokafka API: AIOKafkaConsumer(*topics, bootstrap_servers=…,
+            # group_id=…, enable_auto_commit=…, auto_offset_reset=
+            # "earliest"|"latest") — with auto-commit off, positions move
+            # only on explicit commit(); group offsets are keyed
+            # (group_id, topic-partition)
             assert enable_auto_commit is False, \
                 "contract: manual commit only (commit-after-peek)"
             self.topic, self.group = topic, group_id
@@ -75,6 +97,8 @@ def make_fake_aiokafka(broker: FakeBroker):
             self._last_peeked = None
 
         async def start(self):
+            # aiokafka API: start() joins the group and seeks to the
+            # committed offset if one exists, else to auto_offset_reset
             self.started = True
             key = (self.group, self.topic)
             if key in broker.committed:
@@ -88,6 +112,11 @@ def make_fake_aiokafka(broker: FakeBroker):
             self.started = False
 
         async def getmany(self, timeout_ms=0, max_records=None):
+            # aiokafka API: getmany(timeout_ms=…, max_records=…) returns
+            # {TopicPartition: [ConsumerRecord(topic, partition, offset,
+            # value, …)]} — possibly empty after the timeout — and ADVANCES
+            # the in-memory position past the returned records (commit()
+            # is what persists it to the group)
             assert self.started
             log = broker.topics.get(self.topic, [])
             records = [
@@ -103,6 +132,9 @@ def make_fake_aiokafka(broker: FakeBroker):
             return {_TP(self.topic): records}
 
         async def commit(self):
+            # aiokafka API: commit() (no args) commits the CONSUMED
+            # positions — i.e. the offsets already returned by getmany —
+            # for the consumer's group; raises if the consumer is stopped
             assert self.started
             if self._last_peeked is not None:
                 broker.committed[(self.group, self.topic)] = self._last_peeked
@@ -125,6 +157,10 @@ def make_fake_aiokafka(broker: FakeBroker):
             pass
 
         async def create_topics(self, new_topics):
+            # aiokafka API: AIOKafkaAdminClient.create_topics([NewTopic(
+            # name, num_partitions, replication_factor, topic_configs={
+            # "retention.bytes": …})]) — TopicAlreadyExistsError on dup
+            # (the product catches and ignores it)
             for t in new_topics:
                 broker.create_calls.append(t)
                 broker.topics.setdefault(t.name, [])
@@ -292,3 +328,140 @@ class TestKafkaContract:
 
         got = asyncio.run(go())
         assert got == [f"a{i}".encode() for i in range(6)]
+
+
+def _real_kafka_available():
+    import importlib.util
+    import os
+    return (importlib.util.find_spec("aiokafka") is not None
+            and bool(os.environ.get("OPENWHISK_TPU_KAFKA_BOOTSTRAP")))
+
+
+@pytest.mark.kafka
+@pytest.mark.skipif(not _real_kafka_available(),
+                    reason="needs `pip install aiokafka` + "
+                           "OPENWHISK_TPU_KAFKA_BOOTSTRAP=<host:port> "
+                           "(see docs/reference.md, Kafka backend)")
+class TestKafkaIntegration:
+    """The SAME behavioral contract as TestKafkaContract, against the real
+    aiokafka client and a real broker (ref KafkaConnectorTests.scala:1).
+    Topics are uniquified per run so reruns don't see stale backlogs."""
+
+    @pytest.fixture
+    def real_kafka(self):
+        import os
+        import uuid
+
+        import openwhisk_tpu.messaging.kafka as kafka
+        assert kafka.HAVE_KAFKA
+        bootstrap = os.environ["OPENWHISK_TPU_KAFKA_BOOTSTRAP"]
+        return kafka, bootstrap, f"owtpu-{uuid.uuid4().hex[:8]}"
+
+    @staticmethod
+    async def _topic_ready(provider, topic):
+        """ensure_topic spawns the admin create as a task and returns the
+        handle: await it so produce happens strictly after create (a fixed
+        sleep races slow brokers; with auto-create enabled the race would
+        silently make the topic with broker-default configs)."""
+        task = provider.ensure_topic(topic)
+        if task is not None:
+            await task
+        return provider.get_producer()
+
+    @staticmethod
+    async def _peek_all(consumer, n, deadline=30.0):
+        """Accumulate peeks until `n` records arrive: the real client's
+        getmany() may return fewer records than max_records even when
+        more are pending (it answers on the first non-empty fetch),
+        unlike the in-repo fake which drains the log in one call."""
+        got = []
+        end = asyncio.get_event_loop().time() + deadline
+        while len(got) < n and asyncio.get_event_loop().time() < end:
+            batch = await consumer.peek(n - len(got), timeout=2.0)
+            got.extend(v for (_, _, _, v) in batch)
+        return got
+
+    def test_send_peek_commit_ordering(self, real_kafka):
+        kafka, bootstrap, topic = real_kafka
+
+        async def go():
+            provider = kafka.KafkaMessagingProvider(bootstrap)
+            producer = await self._topic_ready(provider, topic)
+            for i in range(5):
+                await producer.send(topic, f"m{i}".encode())
+            c1 = provider.get_consumer(topic, f"{topic}-g")
+            assert await self._peek_all(c1, 2) == [b"m0", b"m1"]
+            commit_task = c1.commit()
+            if commit_task is not None:  # commit-before-handoff ordering
+                await commit_task
+            assert await self._peek_all(c1, 2) == [b"m2", b"m3"]
+            await c1.close()  # m2/m3 NOT committed
+            c2 = provider.get_consumer(topic, f"{topic}-g")
+            assert await self._peek_all(c2, 3) == [b"m2", b"m3", b"m4"]
+            await c2.close()
+            await producer.close()
+
+        asyncio.run(go())
+
+    def test_from_latest_skips_backlog(self, real_kafka):
+        kafka, bootstrap, topic = real_kafka
+
+        async def go():
+            provider = kafka.KafkaMessagingProvider(bootstrap)
+            producer = await self._topic_ready(provider, topic)
+            await producer.send(topic, b"old-ping")
+            c = provider.get_consumer(topic, f"{topic}-health",
+                                      from_latest=True)
+            assert await c.peek(10, timeout=2.0) == []
+            await producer.send(topic, b"new-ping")
+            assert await self._peek_all(c, 1) == [b"new-ping"]
+            await c.close()
+            await producer.close()
+
+        asyncio.run(go())
+
+    def test_oversized_payload_surfaces(self, real_kafka):
+        kafka, bootstrap, topic = real_kafka
+
+        async def go():
+            provider = kafka.KafkaMessagingProvider(bootstrap)
+            producer = await self._topic_ready(provider, topic)
+            with pytest.raises(Exception, match="(?i)too.?large|size"):
+                await producer.send(topic, b"x" * (kafka.MAX_REQUEST_SIZE + 1))
+            await producer.close()
+
+        asyncio.run(go())
+
+    def test_message_feed_pipeline_end_to_end(self, real_kafka):
+        """MessageFeed over the real consumer: capacity-gated pull +
+        processed() credit, the invoker's consumption pattern."""
+        from openwhisk_tpu.messaging.connector import MessageFeed
+
+        kafka, bootstrap, topic = real_kafka
+
+        async def go():
+            provider = kafka.KafkaMessagingProvider(bootstrap)
+            producer = await self._topic_ready(provider, topic)
+            got = []
+            box = {}
+
+            async def handle(payload):
+                got.append(payload)
+                box["feed"].processed()
+
+            consumer = provider.get_consumer(topic, f"{topic}-feed")
+            feed = MessageFeed(topic, consumer, 8, handle)
+            box["feed"] = feed
+            feed.start()
+            for i in range(12):
+                await producer.send(topic, f"f{i}".encode())
+            for _ in range(100):
+                if len(got) >= 12:
+                    break
+                await asyncio.sleep(0.2)
+            await feed.stop()
+            await producer.close()
+            return got
+
+        got = asyncio.run(go())
+        assert got == [f"f{i}".encode() for i in range(12)]

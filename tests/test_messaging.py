@@ -268,3 +268,30 @@ class TestRetentionAndFromLatest:
             return [p for (_t, _pp, _o, p) in batch]
 
         assert asyncio.run(go()) == [b"p1", b"p2"]
+
+
+class TestProviderForBus:
+    def test_default_is_tcp(self):
+        from openwhisk_tpu.messaging import provider_for_bus
+        from openwhisk_tpu.messaging.tcp import TcpMessagingProvider
+        p = provider_for_bus("127.0.0.1:4555")
+        assert isinstance(p, TcpMessagingProvider)
+
+    def test_spi_binding_overrides(self, monkeypatch):
+        """CONFIG_whisk_spi_MessagingProvider selects the backend for the
+        service mains (the Kafka runbook's mechanism); the implementation
+        receives the --bus address as its bootstrap argument."""
+        from openwhisk_tpu.messaging import provider_for_bus
+
+        monkeypatch.setenv(
+            "CONFIG_whisk_spi_MessagingProvider",
+            "openwhisk_tpu.messaging.memory:MemoryMessagingProvider")
+        from openwhisk_tpu import spi
+        spi.reset()
+        try:
+            from openwhisk_tpu.messaging import MemoryMessagingProvider
+            p = provider_for_bus("broker:9092")
+            # Memory takes no bootstrap: the TypeError fallback engages
+            assert isinstance(p, MemoryMessagingProvider)
+        finally:
+            spi.reset()

@@ -441,6 +441,30 @@ class TestApiDocs:
         assert "post" in paths["/api/v1/namespaces/{ns}/actions/{name}"]
         assert "/api/v1/namespaces/{ns}/apis" in paths
 
+    def test_swagger_ui_page_and_docs_redirect(self):
+        """ref RestAPIs.scala:50-81: the swagger UI page is served
+        unauthenticated (self-contained — no CDN assets) and /docs
+        redirects to it."""
+        async def go(s):
+            out = {}
+            async with s.get(f"{BASE}/api-docs/ui") as r:
+                out["ui"] = (r.status, r.headers["Content-Type"],
+                             await r.text())
+            async with s.get(f"http://127.0.0.1:{PORT}/docs") as r:
+                out["redirect"] = (r.status, str(r.url))
+            return out
+
+        out = run_system(go)
+        status, ctype, html = out["ui"]
+        assert status == 200 and "text/html" in ctype
+        assert "OpenWhisk-TPU REST API" in html
+        assert "fetch('/api/v1/api-docs')" in html  # the JSON, same-origin
+        # strictly self-contained: no external URLs at all (must render
+        # in air-gapped deployments)
+        assert "http://" not in html and "https://" not in html
+        r_status, r_url = out["redirect"]
+        assert r_status == 200 and r_url.endswith("/api/v1/api-docs/ui")
+
 
 class TestPackageBindings:
     def test_invoke_through_binding_merges_parameters(self):
