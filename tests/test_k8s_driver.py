@@ -35,7 +35,27 @@ def main(args):
 
 
 class PodRunningKubeAPI:
-    """A pods API whose pods are real actionproxy processes."""
+    """A pods API whose pods are real actionproxy processes.
+
+    Conformance notes (Kubernetes core/v1 Pod API reference) — the
+    assumptions this fake encodes, reviewable per endpoint:
+      - POST /api/v1/namespaces/{ns}/pods answers 201 with the Pod object;
+        a pod is ACCEPTED (201) even when its image can never pull — the
+        failure surfaces later as status.phase=Failed (ImagePullBackOff
+        class), never as a POST error. The driver must poll, not trust
+        the create response.
+      - GET .../pods/{name} returns the Pod with status.phase in
+        Pending|Running|Failed|Succeeded and status.podIP populated only
+        once Running. Unknown pod: 404 with an (empty here) Status body.
+      - GET .../pods?labelSelector=k=v returns a PodList {"items": [...]}
+        filtered by EXACT label match (equality selector semantics).
+      - DELETE .../pods/{name} is asynchronous on real clusters (the pod
+        enters Terminating and survives a grace period); the driver
+        treats 200 as accepted-for-deletion, which this fake satisfies
+        by deleting immediately (a stricter-than-real but contract-safe
+        behavior for the driver's fire-and-forget destroy).
+      - GET .../pods/{name}/log returns plain text (not JSON).
+    """
 
     def __init__(self):
         self.pods = {}      # name -> manifest (+ our bookkeeping)

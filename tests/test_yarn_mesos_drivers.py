@@ -67,6 +67,17 @@ async def _serve(app):
 
 # -------------------------------------------------------------------- mesos
 class RealMesosBridge:
+    """Conformance notes (mesos-actor bridge REST API, the contract the
+    reference's MesosContainerFactory drives — ref
+    MesosContainerFactory.scala + the mesos-actor project's HTTP bridge):
+      - POST /tasks submits a TaskDef and answers with the task's
+        eventual host:port binding once the agent launches it (the
+        reference BLOCKS on the bridge for task-running).
+      - GET /tasks lists running tasks; DELETE /tasks/{id} kills one.
+      - POST /teardown unregisters the framework, killing all tasks —
+        the factory calls it exactly once at shutdown.
+    Tasks here are real actionproxy processes bound to loopback IPs."""
+
     def __init__(self):
         self.tasks = {}  # id -> (proc, host, port)
         self.torn_down = False
@@ -180,7 +191,23 @@ class TestMesosDriverExecutes:
 
 # --------------------------------------------------------------------- yarn
 class RealYARNAPI:
-    """Services API whose component instances are real processes."""
+    """Services API whose component instances are real processes.
+
+    Conformance notes (Apache Hadoop YARN Services API v1, the contract
+    the reference's YARNContainerFactory drives — ref
+    YARNContainerFactory.scala + hadoop's yarn-service REST docs):
+      - POST /app/v1/services creates a service (202-accepted class;
+        the factory polls describe until STABLE).
+      - GET /app/v1/services/{svc} returns the Service JSON incl.
+        components[].containers[] with bare_host + state READY once an
+        instance is up.
+      - PUT /app/v1/services/{svc} with {"components": [...]} adds
+        components; PUT .../components/{comp} with
+        {"number_of_containers": N} FLEXES the component up/down — the
+        factory allocates one container per flex-up and destroys by
+        flexing down (instances are removed highest-ordinal-first,
+        which the driver's bookkeeping mirrors).
+      - DELETE /app/v1/services/{svc} stops + destroys the service."""
 
     def __init__(self):
         self.services = {}   # name -> {components: {comp: {...}}}
