@@ -280,8 +280,23 @@ class TestDocstoreFailover:
 
                     cluster.kill("docstore")
                     cluster.start_docstore()
-                    # clients reconnect lazily on the next request; allow the
-                    # restart window, then require sustained success
+                    # wait for the restarted docstore to LISTEN before the
+                    # measured window: its boot time is load-dependent (a
+                    # fresh interpreter on a busy 1-core box can take
+                    # seconds), and what this test asserts is that CLIENTS
+                    # RECONNECT once it's back — not how fast it boots
+                    for _ in range(240):
+                        try:
+                            socket.create_connection(
+                                ("127.0.0.1", cluster.docstore_port),
+                                timeout=0.25).close()
+                            break
+                        except OSError:
+                            await asyncio.sleep(0.25)
+                    else:
+                        pytest.fail("docstore never listened after restart")
+                    # clients reconnect lazily on the next request; then
+                    # require sustained success
                     ok = 0
                     for n in range(16):
                         try:
