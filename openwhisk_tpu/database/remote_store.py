@@ -360,7 +360,10 @@ class RemoteArtifactStoreProvider:
 def open_store(db: str) -> ArtifactStore:
     """Resolve a --db argument: `docstore://host:port` connects to a shared
     DocStoreServer; `couchdb://host:port/dbname` (or couchdbs:// for TLS)
-    connects to a CouchDB server; anything else is a local sqlite path."""
+    connects to a CouchDB server; `cosmos://KEY@host:port/db/container`
+    (cosmoss:// for TLS; KEY percent-encoded base64 master key) connects
+    to an Azure Cosmos DB SQL-API account or emulator; anything else is a
+    local sqlite path."""
     if db.startswith("docstore://"):
         hostport = db[len("docstore://"):]
         host, _, port = hostport.rpartition(":")
@@ -380,6 +383,22 @@ def open_store(db: str) -> ArtifactStore:
             db=(parts.path.strip("/") or "whisks"),
             username=unquote(parts.username) if parts.username else None,
             password=unquote(parts.password) if parts.password else None)
+    if db.startswith(("cosmos://", "cosmoss://")):
+        from urllib.parse import unquote, urlsplit
+
+        from .cosmosdb_store import CosmosDbArtifactStore
+        parts = urlsplit(db)
+        scheme = "https" if parts.scheme == "cosmoss" else "http"
+        if not parts.username:
+            raise ValueError(
+                "cosmos:// needs the master key as userinfo: "
+                "cosmos://KEY@host:port/db/container")
+        segs = [s for s in parts.path.split("/") if s]
+        return CosmosDbArtifactStore(
+            f"{scheme}://{parts.hostname or '127.0.0.1'}:{parts.port or 8081}",
+            key=unquote(parts.username),
+            db=segs[0] if segs else "whisks",
+            container=segs[1] if len(segs) > 1 else "whisks")
     from .sqlite_store import SqliteArtifactStore
     return SqliteArtifactStore(db)
 

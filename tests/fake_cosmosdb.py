@@ -1,0 +1,273 @@
+"""A faithful in-process Azure Cosmos DB (SQL API) REST emulator.
+
+Conformance notes (Cosmos DB REST API reference) — the assumptions this
+fake encodes, reviewable per endpoint:
+
+  - **Auth**: every request must carry `Authorization` = the urlencoded
+    master-key token `type=master&ver=1.0&sig=<b64 hmac>`, `x-ms-date`
+    (RFC 1123), and `x-ms-version`. The signature is HMAC-SHA256 over
+    lower(verb) + "\\n" + lower(resourceType) + "\\n" + resourceLink +
+    "\\n" + lower(date) + "\\n" + "\\n", keyed by the base64-decoded
+    master key ("Access control in the Azure Cosmos DB SQL API"). This
+    fake RECOMPUTES the signature for every request and answers 401 on
+    mismatch, so the client's signing is genuinely executed.
+  - **POST /dbs** creates a database: 201, or 409 if it exists.
+  - **POST /dbs/{db}/colls** creates a container (with partitionKey
+    definition): 201 / 409.
+  - **POST .../docs** creates a document: 201 with the stored document
+    (system properties `_etag`, `_ts` added); 409 Conflict when the id
+    already exists in the partition. With the
+    `x-ms-documentdb-is-upsert: true` header it would upsert (the store
+    never uses upsert — creates are conflict-checked on purpose).
+  - **GET .../docs/{id}** point-read: 200 with the document, 404 when
+    missing; the `x-ms-documentdb-partitionkey` header must name the
+    document's partition (a wrong partition key reads as 404, which is
+    exactly the bug class the store's id-derived partition roots avoid).
+  - **PUT .../docs/{id}** replaces: 200; honors `If-Match` — a stale
+    etag is **412 Precondition Failed**; a missing id is 404.
+  - **DELETE .../docs/{id}**: **204 No Content**; 404 when missing; 412
+    on a stale `If-Match`.
+  - **Queries**: POST .../docs with `x-ms-documentdb-isquery: true` and
+    Content-Type `application/query+json`, body
+    {"query": sql, "parameters": [{"name": "@p", "value": v}, ...]} →
+    200 {"Documents": [...], "_count": N}. Single-partition queries use
+    the partition-key header; cross-partition ones must send
+    `x-ms-documentdb-query-enablecrosspartition: true` (enforced here:
+    a cross-partition query without the header is 400, the documented
+    behavior). Results page via the `x-ms-continuation` header (this
+    fake pages every PAGE_SIZE docs to force the client's continuation
+    loop to execute).
+  - **SQL dialect**: the fake evaluates the exact parameterized query
+    family the store emits — equality/range predicates over scalar
+    fields, STARTSWITH, ORDER BY one field ASC|DESC, OFFSET/LIMIT, and
+    SELECT VALUE COUNT(1) — not general SQL.
+  - **_etag** is a quoted GUID-ish string regenerated on every write;
+    If-Match compares the exact string.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import re
+import uuid
+
+from aiohttp import web
+
+MASTER_KEY = base64.b64encode(b"fake-cosmos-master-key-32-bytes!").decode()
+PAGE_SIZE = 3  # tiny: every multi-doc query exercises continuation
+
+
+class FakeCosmosDB:
+    def __init__(self, key: str = MASTER_KEY):
+        self.key = base64.b64decode(key)
+        self.dbs: dict = {}   # db -> {coll -> {(pk, id) -> doc}}
+        self.runner = None
+        self.unauthorized = 0
+        self.queries: list = []
+
+    # ------------------------------------------------------------- server
+    async def start(self) -> str:
+        app = web.Application()
+        app.router.add_post("/dbs", self.create_db)
+        app.router.add_post("/dbs/{db}/colls", self.create_coll)
+        app.router.add_post("/dbs/{db}/colls/{coll}/docs", self.docs_post)
+        app.router.add_get("/dbs/{db}/colls/{coll}/docs/{id}", self.doc_get)
+        app.router.add_put("/dbs/{db}/colls/{coll}/docs/{id}", self.doc_put)
+        app.router.add_delete("/dbs/{db}/colls/{coll}/docs/{id}",
+                              self.doc_delete)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{port}"
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+    # --------------------------------------------------------------- auth
+    def _check_auth(self, req: web.Request, resource_type: str,
+                    resource_link: str):
+        from urllib.parse import unquote
+        date = req.headers.get("x-ms-date", "")
+        sts = (f"{req.method.lower()}\n{resource_type.lower()}\n"
+               f"{resource_link}\n{date.lower()}\n\n")
+        want = base64.b64encode(
+            hmac.new(self.key, sts.encode(), hashlib.sha256).digest()
+        ).decode()
+        got = unquote(req.headers.get("Authorization", ""))
+        if got != f"type=master&ver=1.0&sig={want}" or \
+                not req.headers.get("x-ms-version"):
+            self.unauthorized += 1
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"code": "Unauthorized"}))
+
+    @staticmethod
+    def _etag() -> str:
+        return f"\"{uuid.uuid4()}\""
+
+    # ---------------------------------------------------------- databases
+    async def create_db(self, req):
+        self._check_auth(req, "dbs", "")
+        body = await req.json()
+        if body["id"] in self.dbs:
+            return web.json_response({"code": "Conflict"}, status=409)
+        self.dbs[body["id"]] = {}
+        return web.json_response({"id": body["id"]}, status=201)
+
+    async def create_coll(self, req):
+        db = req.match_info["db"]
+        self._check_auth(req, "colls", f"dbs/{db}")
+        body = await req.json()
+        colls = self.dbs.setdefault(db, {})
+        if body["id"] in colls:
+            return web.json_response({"code": "Conflict"}, status=409)
+        colls[body["id"]] = {}
+        return web.json_response(
+            {"id": body["id"], "partitionKey": body.get("partitionKey")},
+            status=201)
+
+    # ------------------------------------------------------------ helpers
+    def _coll(self, req):
+        db, coll = req.match_info["db"], req.match_info["coll"]
+        return self.dbs.get(db, {}).get(coll)
+
+    @staticmethod
+    def _pk_of(req) -> str:
+        raw = req.headers.get("x-ms-documentdb-partitionkey")
+        return json.loads(raw)[0] if raw else None
+
+    # ---------------------------------------------------------- documents
+    async def docs_post(self, req):
+        db, coll = req.match_info["db"], req.match_info["coll"]
+        self._check_auth(req, "docs", f"dbs/{db}/colls/{coll}")
+        store = self._coll(req)
+        if store is None:
+            return web.json_response({"code": "NotFound"}, status=404)
+        if req.headers.get("x-ms-documentdb-isquery") == "true":
+            return await self._query(req, store)
+        body = json.loads(await req.text())
+        pk = self._pk_of(req)
+        key = (pk, body["id"])
+        if key in store:
+            return web.json_response({"code": "Conflict"}, status=409)
+        doc = dict(body, _etag=self._etag())
+        store[key] = doc
+        return web.json_response(doc, status=201)
+
+    async def doc_get(self, req):
+        db, coll = req.match_info["db"], req.match_info["coll"]
+        doc_id = req.match_info["id"]
+        self._check_auth(req, "docs",
+                         f"dbs/{db}/colls/{coll}/docs/{doc_id}")
+        store = self._coll(req)
+        doc = (store or {}).get((self._pk_of(req), doc_id))
+        if doc is None:
+            return web.json_response({"code": "NotFound"}, status=404)
+        return web.json_response(doc)
+
+    async def doc_put(self, req):
+        db, coll = req.match_info["db"], req.match_info["coll"]
+        doc_id = req.match_info["id"]
+        self._check_auth(req, "docs",
+                         f"dbs/{db}/colls/{coll}/docs/{doc_id}")
+        store = self._coll(req)
+        key = (self._pk_of(req), doc_id)
+        existing = (store or {}).get(key)
+        if existing is None:
+            return web.json_response({"code": "NotFound"}, status=404)
+        if_match = req.headers.get("If-Match")
+        if if_match is not None and if_match != existing["_etag"]:
+            return web.json_response({"code": "PreconditionFailed"},
+                                     status=412)
+        doc = dict(json.loads(await req.text()), _etag=self._etag())
+        store[key] = doc
+        return web.json_response(doc, status=200)
+
+    async def doc_delete(self, req):
+        db, coll = req.match_info["db"], req.match_info["coll"]
+        doc_id = req.match_info["id"]
+        self._check_auth(req, "docs",
+                         f"dbs/{db}/colls/{coll}/docs/{doc_id}")
+        store = self._coll(req)
+        key = (self._pk_of(req), doc_id)
+        existing = (store or {}).get(key)
+        if existing is None:
+            return web.json_response({"code": "NotFound"}, status=404)
+        if_match = req.headers.get("If-Match")
+        if if_match is not None and if_match != existing["_etag"]:
+            return web.json_response({"code": "PreconditionFailed"},
+                                     status=412)
+        del store[key]
+        return web.Response(status=204)
+
+    # -------------------------------------------------------------- query
+    async def _query(self, req, store):
+        body = json.loads(await req.text())
+        self.queries.append(body)
+        pk = self._pk_of(req)
+        cross_ok = req.headers.get(
+            "x-ms-documentdb-query-enablecrosspartition") == "true"
+        if pk is None and not cross_ok:
+            # documented: a cross-partition query must opt in
+            return web.json_response(
+                {"code": "BadRequest",
+                 "message": "cross partition query is required"},
+                status=400)
+        docs = [d for (p, _), d in store.items() if pk is None or p == pk]
+        params = {p["name"]: p["value"] for p in body.get("parameters", [])}
+        sql = body["query"]
+
+        m = re.match(
+            r"SELECT\s+(?P<sel>VALUE COUNT\(1\)|\*|[\w.,\s]+?)\s+FROM\s+c"
+            r"(?:\s+WHERE\s+(?P<where>.*?))?"
+            r"(?:\s+ORDER BY\s+c\.(?P<ofield>\w+)\s+(?P<odir>ASC|DESC))?"
+            r"(?:\s+OFFSET\s+(?P<off>\d+)\s+LIMIT\s+(?P<lim>\d+))?\s*$",
+            sql)
+        if not m:
+            return web.json_response({"code": "BadRequest",
+                                      "message": f"unsupported sql {sql}"},
+                                     status=400)
+
+        def pred(doc, clause):
+            cm = re.match(r"c\.(\w+)\s*(>=|<=|=)\s*(@\w+)", clause)
+            if cm:
+                field, op, p = cm.groups()
+                v, pv = doc.get(field), params[p]
+                if v is None:
+                    return False
+                return {"=": v == pv, ">=": v >= pv,
+                        "<=": v <= pv}[op]
+            sm = re.match(r"STARTSWITH\(c\.(\w+),\s*(@\w+)\)", clause)
+            if sm:
+                field, p = sm.groups()
+                return str(doc.get(field, "")).startswith(params[p])
+            raise AssertionError(f"unsupported clause {clause!r}")
+
+        if m.group("where"):
+            for clause in m.group("where").split(" AND "):
+                docs = [d for d in docs if pred(d, clause.strip())]
+        if m.group("ofield"):
+            docs.sort(key=lambda d: d.get(m.group("ofield"), 0),
+                      reverse=m.group("odir") == "DESC")
+        if m.group("off") is not None:
+            docs = docs[int(m.group("off")):]
+            docs = docs[: int(m.group("lim"))]
+        if m.group("sel") == "VALUE COUNT(1)":
+            return web.json_response({"Documents": [len(docs)],
+                                      "_count": 1})
+        if m.group("sel") not in ("*",):
+            fields = [f.strip().split(".")[-1]
+                      for f in m.group("sel").split(",")]
+            docs = [{k: d.get(k) for k in fields} for d in docs]
+
+        # continuation paging (tiny pages force the client's loop)
+        start = int(req.headers.get("x-ms-continuation") or 0)
+        page = docs[start: start + PAGE_SIZE]
+        headers = {}
+        if start + PAGE_SIZE < len(docs):
+            headers["x-ms-continuation"] = str(start + PAGE_SIZE)
+        return web.json_response({"Documents": page, "_count": len(page)},
+                                 headers=headers)

@@ -101,7 +101,29 @@ class _CouchFixture:
             pass
 
 
-@pytest.fixture(params=["memory", "sqlite", "remote", "couchdb"])
+class _CosmosFixture(_CouchFixture):
+    """FakeCosmosDB + CosmosDbArtifactStore per test event loop; document
+    state persists across loops like a real account would."""
+
+    def __init__(self):  # noqa: super().__init__ builds the couch fake
+        from tests.fake_cosmosdb import MASTER_KEY, FakeCosmosDB
+        self._key = MASTER_KEY
+        self._fake = FakeCosmosDB()
+        self._loop = None
+        self._client = None
+
+    async def _store(self):
+        from openwhisk_tpu.database.cosmosdb_store import \
+            CosmosDbArtifactStore
+        loop = asyncio.get_running_loop()
+        if self._loop is not loop:
+            url = await self._fake.start()
+            self._client = CosmosDbArtifactStore(url, key=self._key)
+            self._loop = loop
+        return self._client
+
+
+@pytest.fixture(params=["memory", "sqlite", "remote", "couchdb", "cosmos"])
 def store(request, tmp_path):
     if request.param == "memory":
         yield MemoryArtifactStore()
@@ -111,6 +133,11 @@ def store(request, tmp_path):
         return
     if request.param == "couchdb":
         fx = _CouchFixture()
+        yield fx
+        fx.teardown()
+        return
+    if request.param == "cosmos":
+        fx = _CosmosFixture()
         yield fx
         fx.teardown()
         return
