@@ -612,16 +612,30 @@ def main() -> None:
     multi = None
     if not args.quick:
         multi = {}
-        for n in (1, 2, 4):
+        # the n=1 baseline runs BEFORE AND AFTER the scale-out runs: the
+        # tunnel channel drifts minute to minute (r01-r05 history), so a
+        # ratio against a single baseline sample is a coin flip — the
+        # scaling factor divides by the mean of the two brackets
+        for key, n in (("n1", 1), ("n2", 2), ("n4", 4), ("n1_b", 1)):
             try:
-                multi[f"n{n}"] = _multi_controller_bench(n)
+                multi[key] = _multi_controller_bench(n, total_per=2500)
             except Exception as e:  # noqa: BLE001 — stage is auxiliary
-                print(f"# multi-controller n={n} failed: {e!r}",
+                print(f"# multi-controller {key} failed: {e!r}",
                       file=sys.stderr)
-        if "n1" in multi and "n2" in multi:
-            r1 = multi["n1"]["aggregate_activations_per_sec"]
+        r1s = [multi[k]["aggregate_activations_per_sec"]
+               for k in ("n1", "n1_b") if k in multi]
+        if r1s and "n2" in multi:
+            r1 = sum(r1s) / len(r1s)
             r2 = multi["n2"]["aggregate_activations_per_sec"]
+            multi["baseline_n1_mean"] = round(r1, 1)
+            multi["baseline_n1_samples"] = len(r1s)  # 1 = a bracket failed
             multi["scaling_1_to_2"] = round(r2 / r1, 2) if r1 else None
+            multi["note"] = (
+                "all controllers + bus + echo fleet share ONE core: "
+                "scale-out can only convert device wire-wait into work, so "
+                "the factor falls as the host path gets faster (r04's "
+                "slower host measured 2.4x here); real deployments give "
+                "each controller its own cores")
 
     cpu_rate = _cpu_oracle_rate()
     # the headline is what the product's kernel="auto" policy resolves to
