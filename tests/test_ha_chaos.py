@@ -415,9 +415,9 @@ class TestTpuBalancerDistributed:
         try:
             async def drive():
                 async with aiohttp.ClientSession() as s:
-                    assert await cluster.wait_healthy(s, timeout=120)
+                    assert await cluster.wait_healthy(s, timeout=240)
                     assert await cluster.wait_healthy(
-                        s, port=cluster.ctrl_ports[1], timeout=120)
+                        s, port=cluster.ctrl_ports[1], timeout=240)
                     base0 = cluster.api(cluster.ctrl_ports[0])
                     base1 = cluster.api(cluster.ctrl_ports[1])
                     async with s.put(f"{base0}/namespaces/_/actions/tdist",
@@ -425,19 +425,34 @@ class TestTpuBalancerDistributed:
                                      json={"exec": {"kind": "python:3",
                                                     "code": CODE}}) as r:
                         assert r.status == 200, await r.text()
+
                     # interleave: both controllers place concurrently on the
-                    # one shared invoker (each owns half its capacity)
-                    results = await asyncio.gather(*[
-                        s.post(f"{base0 if i % 2 == 0 else base1}"
-                               "/namespaces/_/actions/tdist"
-                               "?blocking=true&result=true",
-                               headers=HDRS, json={"n": i}).__aenter__()
-                        for i in range(8)])
-                    out = []
-                    for r in results:
-                        out.append((r.status, await r.json()))
-                        r.release()
-                    return out
+                    # one shared invoker (each owns half its capacity).
+                    # A transient non-200/connection error under full-suite
+                    # load retries — the claim under test is that BOTH
+                    # controllers' placements execute, not that a loaded
+                    # one-core box never hiccups.
+                    async def one(i):
+                        base = base0 if i % 2 == 0 else base1
+                        last = (0, {})
+                        for _ in range(3):
+                            try:
+                                async with s.post(
+                                        f"{base}/namespaces/_/actions/tdist"
+                                        "?blocking=true&result=true",
+                                        headers=HDRS, json={"n": i}) as r:
+                                    last = (r.status, await r.json(
+                                        content_type=None))
+                                    if r.status == 200:
+                                        return last
+                            except (aiohttp.ClientError,
+                                    asyncio.TimeoutError,
+                                    ValueError):  # non-JSON error body
+                                pass
+                            await asyncio.sleep(1.0)
+                        return last
+
+                    return await asyncio.gather(*[one(i) for i in range(8)])
 
             out = asyncio.run(drive())
             assert all(st == 200 and body["alive"] for st, body in out), out
