@@ -15,6 +15,7 @@ controller/cors.py); web actions manage their own CORS + OPTIONS preflight.
 """
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import Optional
 
@@ -110,6 +111,12 @@ class ControllerApi:
         # system
         r.add_get("/invokers", self.invokers)
         r.add_get("/metrics", self.metrics)
+        # placement introspection plane (flight recorder + books), auth-gated
+        # like /invokers: none of these paths are in the anonymous whitelist
+        r.add_get("/admin/placement/recent", self.placement_recent)
+        r.add_get("/admin/placement/explain/{activation_id}",
+                  self.placement_explain)
+        r.add_get("/admin/placement/occupancy", self.placement_occupancy)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -318,6 +325,62 @@ class ControllerApi:
     async def metrics(self, request):
         return web.Response(text=self.c.metrics.prometheus_text(),
                             content_type="text/plain")
+
+    # ------------------------------------------- placement introspection
+    def _flight_recorder(self):
+        return getattr(self.c.load_balancer, "flight_recorder", None)
+
+    async def placement_recent(self, request):
+        """Last N flight-recorder batch records (newest last). `?limit=N`
+        bounds the answer (default 20, capped at the ring size);
+        `?decisions=false` returns digests + timings only."""
+        fr = self._flight_recorder()
+        if fr is None:
+            return _error(404, "this balancer has no flight recorder",
+                          request.get("transid"))
+        try:
+            limit = max(0, int(request.query.get("limit", 20)))
+        except ValueError:
+            return _error(400, "limit must be an integer",
+                          request.get("transid"))
+        with_decisions = request.query.get(
+            "decisions", "true").lower() not in ("false", "0", "no")
+        return web.json_response({
+            "enabled": fr.enabled,
+            "size": fr.size,
+            "recorded": len(fr),
+            "dropped": fr.dropped,
+            "records": fr.recent(limit, with_decisions=with_decisions),
+        })
+
+    async def placement_explain(self, request):
+        """Why did activation X land on invoker Y: the recorded decision row
+        plus the batch record it rode in (input digest + phase timings).
+        404 once the ring has wrapped past the activation."""
+        fr = self._flight_recorder()
+        found = (fr.explain(request.match_info["activation_id"])
+                 if fr is not None else None)
+        if found is None:
+            return _error(
+                404, "activation not in the flight recorder (never placed "
+                "by this controller, recorder disabled, or the ring has "
+                "wrapped past it)", request.get("transid"))
+        return web.json_response(found)
+
+    async def placement_occupancy(self, request):
+        """Per-invoker slots-in-use/capacity derived from the balancer
+        books (device books for the TPU balancer, host semaphores for the
+        CPU balancers)."""
+        lb = self.c.load_balancer
+        if lb is None:
+            return _error(404, "no load balancer", request.get("transid"))
+        if getattr(lb, "OCCUPANCY_SYNCS_DEVICE", False):
+            # worker thread: the TPU balancer's books read forces a device
+            # sync that must not stall the event loop mid-step
+            return web.json_response(await asyncio.to_thread(lb.occupancy))
+        # CPU balancers read loop-owned books: run inline so the iteration
+        # cannot race event-loop mutation
+        return web.json_response(lb.occupancy())
 
     async def list_namespaces(self, request):
         identity: Identity = request["identity"]

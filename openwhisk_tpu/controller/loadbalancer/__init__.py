@@ -2,6 +2,8 @@ from .base import (ActivationEntry, ActiveAckTimeout, CommonLoadBalancer,
                    InvokerHealth, LoadBalancer, LoadBalancerException,
                    LoadBalancerThrottleException,
                    HEALTHY, UNHEALTHY, UNRESPONSIVE, OFFLINE)
+from .flight_recorder import (BatchRecord, FlightRecorder,
+                              FlightRecorderConfig)
 from .lean import LeanBalancer, LeanBalancerProvider
 from .supervision import InvokerPool
 from .sharding_balancer import ShardingBalancer, ShardingBalancerProvider

@@ -28,6 +28,7 @@ from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
                                   parse_ack)
 from ...utils.logging import MetricEmitter
 from ...utils.transaction import TransactionId
+from .flight_recorder import BatchRecord, FlightRecorder
 
 # invoker states (ref InvokerState in InvokerSupervision.scala)
 HEALTHY = "up"
@@ -115,6 +116,19 @@ class LoadBalancer:
     async def invoker_health(self) -> List[InvokerHealth]:
         raise NotImplementedError
 
+    #: True when occupancy() blocks on a device sync — the admin endpoint
+    #: then runs it on a worker thread. CPU balancers keep it False so
+    #: their occupancy() runs inline on the event loop (safe to iterate
+    #: loop-mutated books without copies).
+    OCCUPANCY_SYNCS_DEVICE = False
+
+    def occupancy(self) -> dict:
+        """Per-invoker slots-in-use/capacity derived from the balancer's
+        books (the `/admin/placement/occupancy` introspection surface).
+        Balancers without capacity books answer an empty fleet."""
+        from .flight_recorder import occupancy_json
+        return occupancy_json(None, [])
+
     async def close(self) -> None:
         pass
 
@@ -125,7 +139,8 @@ class CommonLoadBalancer(LoadBalancer):
     STD_TIMEOUT = 60.0
 
     def __init__(self, messaging_provider, controller_instance, logger=None,
-                 metrics: Optional[MetricEmitter] = None):
+                 metrics: Optional[MetricEmitter] = None,
+                 flight_recorder: Optional[FlightRecorder] = None):
         self.provider = messaging_provider
         self.controller = controller_instance
         self.logger = logger
@@ -136,6 +151,11 @@ class CommonLoadBalancer(LoadBalancer):
         self._total = 0
         self._ack_feed: Optional[MessageFeed] = None
         self._health_probe_ids: set = set()
+        # the shared introspection plane: every balancer — TPU or CPU —
+        # reports placement decisions through this recorder, so the
+        # /admin/placement/* endpoints are backend-agnostic
+        self.flight_recorder = (flight_recorder if flight_recorder is not None
+                                else FlightRecorder.from_config())
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -329,6 +349,31 @@ class CommonLoadBalancer(LoadBalancer):
                                             forced=False)
             else:
                 self.metrics.counter("loadbalancer_completion_ack_forcedAfterRegular")
+
+    # -- flight recorder (single-decision hook for CPU balancers) ----------
+    def record_placement(self, msg: ActivationMessage,
+                         action: Union[WhiskAction, ExecutableWhiskAction],
+                         chosen: int, invoker: Optional[InvokerInstanceId],
+                         forced: bool = False, throttled: bool = False,
+                         digest: Optional[dict] = None) -> None:
+        """Record one placement decision as a one-row batch record (the TPU
+        balancer records whole micro-batches itself). CPU balancers carry a
+        `kernel: "cpu"` digest; callers may add backend detail."""
+        fr = self.flight_recorder
+        if not fr.enabled:
+            return
+        d = {"kernel": "cpu", "queue_depth": 0, "oldest_age_ms": 0.0}
+        if digest:
+            d.update(digest)
+        rec = BatchRecord(digest=d, decisions=[(
+            msg.activation_id.asString, str(action.fully_qualified_name),
+            chosen, invoker.as_string if invoker is not None else None,
+            bool(forced), bool(throttled),
+            action.limits.memory.megabytes)])
+        fr.record(rec)
+        self.metrics.gauge("loadbalancer_healthy_invokers",
+                           d.get("healthy_invokers", 0))
+        self.metrics.gauge("loadbalancer_flight_recorder_dropped", fr.dropped)
 
     # -- subclass hooks ----------------------------------------------------
     def release_invoker(self, invoker: InvokerInstanceId, entry: ActivationEntry) -> None:

@@ -36,12 +36,27 @@ class LeanBalancer(CommonLoadBalancer):
 
     async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
                       ) -> asyncio.Future:
+        self.record_placement(msg, action, 0, self.invoker_id,
+                              digest={"healthy_invokers": 1})
         promise = self.setup_activation(msg, action, self.invoker_id)
         await self.send_activation_to_invoker(msg, self.invoker_id)
         return promise
 
     async def invoker_health(self) -> List[InvokerHealth]:
         return [InvokerHealth(self.invoker_id, HEALTHY)]
+
+    def occupancy(self) -> dict:
+        """Lean mode has no capacity books (the in-process invoker's pool
+        buffers pressure): report in-flight activation memory against the
+        invoker's configured memory as a best-effort occupancy view. Runs
+        on the event loop (OCCUPANCY_SYNCS_DEVICE stays False), so the
+        activation_slots iteration cannot race loop-side mutation."""
+        from .flight_recorder import occupancy_json
+        cap = self.invoker_id.user_memory.to_mb
+        used = min(cap, sum(e.memory_mb
+                            for e in self.activation_slots.values()))
+        return occupancy_json("cpu", [(self.invoker_id.as_string, True, cap,
+                                       cap - used, used)])
 
     async def close(self) -> None:
         await super().close()

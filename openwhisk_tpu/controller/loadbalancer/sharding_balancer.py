@@ -15,6 +15,7 @@ from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
 from ...messaging.message import ActivationMessage
 from ...models.sharding_policy import ShardingPolicyState, release, schedule
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth, LoadBalancerException)
+from .flight_recorder import occupancy_json
 from .supervision import InvokerPool
 
 
@@ -72,6 +73,8 @@ class ShardingBalancer(CommonLoadBalancer):
         if forced:
             self.metrics.counter("loadbalancer_forced_placements")
         invoker = self._registry[chosen]
+        self.record_placement(msg, action, chosen, invoker, forced=forced,
+                              digest={"healthy_invokers": sum(self._usable)})
         promise = self.setup_activation(msg, action, invoker)
         await self.send_activation_to_invoker(msg, invoker)
         return promise
@@ -80,6 +83,22 @@ class ShardingBalancer(CommonLoadBalancer):
         action_name = entry.action_key.rsplit("@", 1)[0]
         release(self.policy, invoker.instance, action_name, entry.memory_mb,
                 entry.max_concurrent)
+
+    def occupancy(self) -> dict:
+        """Per-invoker slots-in-use/capacity from the host-side semaphore
+        books (same JSON shape as the TPU balancer's device books).
+        Permits go negative under forced over-commit: used (and the ratio)
+        deliberately exceed capacity then."""
+        def rows():
+            for i, s in enumerate(self.policy.invokers):
+                cap = self.policy.invoker_slot_mb(s.user_memory_mb)
+                permits = s.semaphore.available_permits
+                name = (self._registry[i].as_string
+                        if i < len(self._registry) else f"invoker{i}")
+                yield (name, s.usable, cap, max(0, min(cap, permits)),
+                       cap - permits)
+
+        return occupancy_json("cpu", rows())
 
     def on_invocation_finished(self, invoker, is_system_error, forced) -> None:
         self.supervision.on_invocation_finished(invoker, is_system_error, forced)

@@ -53,6 +53,8 @@ from ...ops.placement import (PlacementState, RequestBatch, init_state,
 from ...ops.throttle import init_buckets
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException, LoadBalancerThrottleException)
+from .flight_recorder import (BatchRecord, free_slot_histogram,
+                              occupancy_json)
 from .supervision import InvokerPool
 
 
@@ -303,10 +305,13 @@ class TpuBalancer(CommonLoadBalancer):
         if self.rate_limit_per_minute is not None:
             self._packed_fn = make_fused_admit_step_packed(self._release_fn,
                                                            self._sched_fn)
-            # bucket state is SOFT (a rolling rate window): re-initialized
-            # full on (re)build/restore rather than checkpointed
-            self._bucket_state = init_buckets(self.RATE_NS_BUCKETS,
-                                              self.rate_limit_per_minute)
+            # bucket state is SOFT (a rolling rate window, never
+            # checkpointed) but it CARRIES across kernel swaps and growth
+            # rebuilds — re-initializing here would grant every namespace a
+            # fresh full burst whenever the fleet grows mid-minute
+            if self._bucket_state is None:
+                self._bucket_state = init_buckets(self.RATE_NS_BUCKETS,
+                                                  self.rate_limit_per_minute)
         else:
             self._packed_fn = make_fused_step_packed(self._release_fn,
                                                      self._sched_fn)
@@ -315,13 +320,18 @@ class TpuBalancer(CommonLoadBalancer):
     def _ns_slot(self, ns_id: str) -> int:
         slot = self._ns_slots.get(ns_id)
         if slot is None:
-            if len(self._ns_slots) < self.RATE_NS_BUCKETS:
+            dedicated = self.RATE_NS_BUCKETS - self.RATE_NS_SHARED_BUCKETS
+            if len(self._ns_slots) < dedicated:
                 # dedicated slot — memoized (bounds the dict at the axis)
                 slot = len(self._ns_slots)
                 self._ns_slots[ns_id] = slot
-            else:  # axis full: stable shared slot (conflated rate), NOT
-                # memoized — crc32 is cheaper than unbounded dict growth
-                slot = zlib.crc32(ns_id.encode()) % self.RATE_NS_BUCKETS
+            else:  # dedicated range full: hash into the reserved SHARED
+                # tail sub-range, NOT the full axis — overflow namespaces
+                # conflate only with each other, never draining a dedicated
+                # tenant's tokens. NOT memoized: crc32 is cheaper than
+                # unbounded dict growth.
+                slot = dedicated + (zlib.crc32(ns_id.encode())
+                                    % self.RATE_NS_SHARED_BUCKETS)
         return slot
 
     def _use_xla_kernels(self) -> None:
@@ -440,6 +450,12 @@ class TpuBalancer(CommonLoadBalancer):
         self.blackbox_count = max(int(self.blackbox_fraction * n), 1) if n else 0
         self._steps_managed = pairwise_coprimes(max(1, self.managed_count))
         self._steps_blackbox = pairwise_coprimes(max(1, self.blackbox_count))
+        # host-side per-invoker capacity vector (this controller's memory
+        # share), kept in sync with the registry so the flight recorder's
+        # occupancy digest never needs a per-step rebuild
+        self._caps_mb = np.asarray(
+            [self._slot_mb(i.user_memory.to_mb) for i in self._registry],
+            np.int64)
 
     def update_cluster(self, cluster_size: int) -> None:
         """Controller joined/left: re-shard every invoker's memory
@@ -447,6 +463,7 @@ class TpuBalancer(CommonLoadBalancer):
         if cluster_size != self._cluster_size:
             self._cluster_size = cluster_size
             self._init_device_state()
+            self._recompute_partitions()  # capacity shares changed
 
     @property
     def cluster_size(self) -> int:
@@ -468,7 +485,7 @@ class TpuBalancer(CommonLoadBalancer):
                                  return_exceptions=True)
         # fail queued publishers instead of leaving them awaiting forever
         pending, self._pending = self._pending, []
-        for req, fut, slot_key in pending:
+        for req, fut, slot_key, *_ in pending:
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
             if not fut.done():
                 fut.set_exception(LoadBalancerException("load balancer shut down"))
@@ -490,14 +507,14 @@ class TpuBalancer(CommonLoadBalancer):
         blackbox = meta.is_blackbox
         size = self.blackbox_count if blackbox else self.managed_count
         offset = (n - self.blackbox_count) if blackbox else 0
-        h = generate_hash(str(msg.user.namespace.name),
-                          str(action.fully_qualified_name))
+        fqn_str = str(action.fully_qualified_name)
+        h = generate_hash(str(msg.user.namespace.name), fqn_str)
         steps = self._steps_blackbox if blackbox else self._steps_managed
         step = steps[h % len(steps)]
         self._rand_counter += 1
         mem = action.limits.memory.megabytes
         maxc = action.limits.concurrency.max_concurrent
-        slot_key = f"{action.fully_qualified_name}:{mem}"
+        slot_key = f"{fqn_str}:{mem}"
         self._ensure_slot_capacity(slot_key)
         # request row in packed-matrix order (see _dispatch_batch): a plain
         # tuple converts to the int32 batch matrix in one C-speed np.array
@@ -509,7 +526,10 @@ class TpuBalancer(CommonLoadBalancer):
                (h ^ (self._rand_counter * 2654435761)) % max(size, 1), 1,
                ns_slot)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending.append((req, fut, slot_key))
+        # trailing fields feed the flight recorder: enqueue time (queue-age
+        # digest) and the activation/action ids for the decision row
+        self._pending.append((req, fut, slot_key, time.monotonic(),
+                              msg.activation_id.asString, fqn_str))
         # inline fast path: with free pipeline capacity, dispatch NOW
         # (synchronously — the assembly+enqueue body has no awaits) when the
         # batch is full, or on an idle FAST device (sub-window round trips:
@@ -580,6 +600,33 @@ class TpuBalancer(CommonLoadBalancer):
 
     async def invoker_health(self) -> List[InvokerHealth]:
         return self.supervision.health()
+
+    #: occupancy() forces a device->host sync — the admin endpoint runs it
+    #: on a worker thread so the event loop keeps serving mid-step
+    OCCUPANCY_SYNCS_DEVICE = True
+
+    def occupancy(self) -> dict:
+        """Per-invoker slots-in-use/capacity from the device books. Admin
+        cold path: the np.asarray forces one device->host transfer of the
+        free_mb vector, acceptable per introspection request. Runs on a
+        worker thread, so the host books are snapshotted up front (list()
+        is atomic under the GIL) and every index is length-guarded against
+        concurrent fleet growth on the event loop."""
+        free = np.asarray(self.state.free_mb)
+        registry = list(self._registry)
+        healthy = list(self._healthy)
+        caps = self._caps_mb
+
+        def rows():
+            for i, inv in enumerate(registry):
+                cap = (int(caps[i]) if i < len(caps)
+                       else self._slot_mb(inv.user_memory.to_mb))
+                f = int(free[i]) if i < len(free) else cap
+                yield (inv.as_string,
+                       healthy[i] if i < len(healthy) else False,
+                       cap, f, cap - f)
+
+        return occupancy_json(self.kernel_resolved, rows())
 
     # -- checkpoint / resume (SURVEY §5.4) ---------------------------------
     def snapshot_parts(self) -> dict:
@@ -671,9 +718,14 @@ class TpuBalancer(CommonLoadBalancer):
     #: request-tuple field indices (row order of the packed matrix)
     R_NEED_MB, R_CONC_SLOT, R_MAX_CONC = 4, 5, 6
 
-    #: namespace-bucket axis for device rate admission (conflates via CRC32
-    #: past this many distinct namespaces)
+    #: namespace-bucket axis for device rate admission
     RATE_NS_BUCKETS = 1024
+
+    #: tail sub-range of the bucket axis reserved for overflow namespaces
+    #: (beyond RATE_NS_BUCKETS - RATE_NS_SHARED_BUCKETS dedicated tenants):
+    #: they CRC32-hash into these shared buckets, so conflation stays among
+    #: overflow namespaces instead of draining dedicated tenants' tokens
+    RATE_NS_SHARED_BUCKETS = 64
 
     #: health updates drained per device step — a FIXED batch shape, so the
     #: fused program's compile-cache keys vary only in (release, batch)
@@ -779,7 +831,17 @@ class TpuBalancer(CommonLoadBalancer):
         req_np[1, b:] = 1  # size
         req_np[6, b:] = 1  # max_conc
         req_np[:, :b] = np.array(
-            [r[:rows] for r, _, _ in batch], np.int32).T
+            [entry[0][:rows] for entry in batch], np.int32).T
+        # flight-recorder input digest, captured host-side before the step
+        # (batch is FIFO: batch[0] carries the oldest enqueue time)
+        rec = None
+        if self.flight_recorder.enabled:
+            rec = BatchRecord(digest={
+                "kernel": self.kernel_resolved,
+                "healthy_invokers": sum(self._healthy),
+                "queue_depth": b + len(self._pending),
+                "oldest_age_ms": round((t0 - batch[0][3]) * 1e3, 3),
+            })
         rel_np = self._release_packed()
         health_np = self._health_packed()
         # releases + health flips + schedule: ONE device program over ONE
@@ -806,7 +868,7 @@ class TpuBalancer(CommonLoadBalancer):
             # recovered by forced-timeout self-heal)
             self._inflight_steps -= 1
             self._capacity_free.set()
-            for req, fut, slot_key in batch:
+            for req, fut, slot_key, *_ in batch:
                 self._slots.release(slot_key, req[self.R_CONC_SLOT])
                 if not fut.done():
                     fut.set_exception(
@@ -824,6 +886,10 @@ class TpuBalancer(CommonLoadBalancer):
         self.metrics.histogram("loadbalancer_tpu_dispatch_ms",
                                (t_dispatched - t_assembled) * 1e3)
         self.metrics.histogram("loadbalancer_tpu_batch_size", b)
+        if rec is not None:
+            rec.timings["assembly_ms"] = round((t_assembled - t0) * 1e3, 3)
+            rec.timings["dispatch_ms"] = round(
+                (t_dispatched - t_assembled) * 1e3, 3)
         # pipelined readback: dispatch returns future arrays immediately, so
         # the NEXT batch can dispatch (chained on device) while this batch's
         # results cross the wire on a worker thread — on a tunneled chip the
@@ -831,7 +897,8 @@ class TpuBalancer(CommonLoadBalancer):
         # throughput at batch/RTT. Dispatch stays event-loop-serialized
         # under the step lock; only readbacks overlap.
         task = asyncio.get_event_loop().create_task(
-            self._readback_step(batch, b, out, t0, req_np))
+            self._readback_step(batch, b, out, t0, req_np, rec,
+                                self.state.free_mb))
         self._readbacks.add(task)
         task.add_done_callback(self._readbacks.discard)
 
@@ -840,7 +907,8 @@ class TpuBalancer(CommonLoadBalancer):
         a separate method so tests can inject readback failures."""
         return unpack_chosen(np.asarray(out))  # (chosen, forced, throttled)
 
-    async def _readback_step(self, batch, b, out, t0, req_np) -> None:
+    async def _readback_step(self, batch, b, out, t0, req_np, rec=None,
+                             books_free=None) -> None:
         # the step-duration stamp is taken ON the worker thread so the
         # metric measures device step + readback, not loop re-scheduling
         def _read():
@@ -851,6 +919,20 @@ class TpuBalancer(CommonLoadBalancer):
             self.metrics.histogram("loadbalancer_tpu_readback_ms", rb_ms)
             # benign cross-thread write: a float EWMA steering a heuristic
             self._rtt_ewma_ms = 0.8 * self._rtt_ewma_ms + 0.2 * rb_ms
+            if rec is not None:
+                # books digest off the POST-step free_mb captured at
+                # dispatch: the transfer happens here on the worker thread
+                # (tiny — n_pad int32s — and off the event loop)
+                free_np = np.asarray(books_free)
+                caps = self._caps_mb
+                n_reg = min(len(caps), len(free_np))
+                cap_total = int(caps[:n_reg].sum())
+                used = cap_total - int(free_np[:n_reg].sum())
+                rec.digest["free_slot_hist"] = free_slot_histogram(
+                    free_np[:n_reg], MIN_SLOT_MB)
+                rec.digest["occupancy"] = (
+                    round(used / cap_total, 4) if cap_total else 0.0)
+                rec.timings["readback_ms"] = round(rb_ms, 3)
             return arrs, t_r1
 
         try:
@@ -877,7 +959,7 @@ class TpuBalancer(CommonLoadBalancer):
                 # reassigned to a different action and inherit the phantom
                 # concurrency; restart/self-heal owns recovery from here
                 compensated = False
-            for req, fut, slot_key in batch:
+            for req, fut, slot_key, *_ in batch:
                 if compensated:
                     self._slots.release(slot_key, req[self.R_CONC_SLOT])
                 if not fut.done():
@@ -898,7 +980,7 @@ class TpuBalancer(CommonLoadBalancer):
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)
         t_f0 = time.monotonic()
-        for (req, fut, slot_key), inv_idx, f, thr in zip(
+        for (req, fut, slot_key, *_), inv_idx, f, thr in zip(
                 batch, chosen_np, forced_np, throttled_np):
             if fut.cancelled():
                 # abandoned publisher (client disconnected while awaiting
@@ -908,8 +990,36 @@ class TpuBalancer(CommonLoadBalancer):
                 self._abandon_placement(int(inv_idx), req, slot_key)
             elif not fut.done():
                 fut.set_result((-2 if thr else int(inv_idx), bool(f)))
+        t_f1 = time.monotonic()
         self.metrics.histogram("loadbalancer_tpu_fanout_ms",
-                               (time.monotonic() - t_f0) * 1e3)
+                               (t_f1 - t_f0) * 1e3)
+        if rec is not None:
+            self._record_batch(rec, batch, chosen_np, forced_np, throttled_np,
+                               (t_f1 - t_f0) * 1e3)
+
+    def _record_batch(self, rec, batch, chosen_np, forced_np, throttled_np,
+                      fanout_ms: float) -> None:
+        """Finish and file the flight-recorder record for one micro-batch,
+        and refresh the introspection gauges."""
+        n_reg = len(self._registry)
+        decisions = rec.decisions
+        for (req, fut, slot_key, t_enq, aid, act), ci, f, thr in zip(
+                batch, chosen_np, forced_np, throttled_np):
+            ci = int(ci)
+            name = (self._registry[ci].as_string
+                    if 0 <= ci < n_reg else None)
+            decisions.append((aid, act, ci, name, bool(f), bool(thr),
+                              req[self.R_NEED_MB]))
+        rec.timings["fanout_ms"] = round(fanout_ms, 3)
+        fr = self.flight_recorder
+        fr.record(rec)
+        m = self.metrics
+        d = rec.digest
+        m.gauge("loadbalancer_placement_queue_depth", d["queue_depth"])
+        m.gauge("loadbalancer_placement_batch_age_ms", d["oldest_age_ms"])
+        m.gauge("loadbalancer_healthy_invokers", d["healthy_invokers"])
+        m.gauge("loadbalancer_fleet_occupancy_ratio", d.get("occupancy", 0.0))
+        m.gauge("loadbalancer_flight_recorder_dropped", fr.dropped)
 
 
 class TpuBalancerProvider:

@@ -14,6 +14,8 @@ def provider_for_bus(bus_addr: str) -> MessagingProvider:
     — with `--bus` handed to the implementation as its bootstrap address
     (Kafka: bootstrap servers; TCP: split host:port). Default: the
     built-in TCP bus at `--bus host:port`."""
+    import inspect
+
     from .tcp import TcpMessagingProvider
     from .. import spi
     host, _, port = bus_addr.partition(":")
@@ -23,10 +25,18 @@ def provider_for_bus(bus_addr: str) -> MessagingProvider:
             return impl  # bound instance
         if isinstance(impl, type) and issubclass(impl, TcpMessagingProvider):
             return impl(host, int(port or 4222))
+        # decide UP FRONT whether the provider takes a bootstrap address —
+        # calling impl(bus_addr) and retrying impl() on TypeError would
+        # swallow genuine TypeErrors raised INSIDE the constructor (bad
+        # config) and silently instantiate without the address
         try:
-            return impl(bus_addr)
-        except TypeError:  # providers without a bootstrap argument
-            return impl()
+            params = inspect.signature(impl).parameters.values()
+            takes_addr = any(
+                p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                           p.VAR_POSITIONAL) for p in params)
+        except (TypeError, ValueError):
+            takes_addr = True  # C-level callables without signatures
+        return impl(bus_addr) if takes_addr else impl()
     return TcpMessagingProvider(host, int(port or 4222))
 
 

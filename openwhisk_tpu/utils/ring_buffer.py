@@ -1,12 +1,18 @@
-"""Fixed-size ring buffer (ref common/scala/.../utils/RingBuffer.scala).
+"""Fixed-size ring buffers (ref common/scala/.../utils/RingBuffer.scala).
 
-Used by invoker supervision to keep the last N invocation results
-(InvokerSupervision.scala:435-443 keeps 10 with error tolerance 3).
+`RingBuffer` is used by invoker supervision to keep the last N invocation
+results (InvokerSupervision.scala:435-443 keeps 10 with error tolerance 3).
+
+`SeqRingBuffer` backs the placement flight recorder
+(controller/loadbalancer/flight_recorder.py): a pre-sized slot array with
+monotonically increasing sequence numbers, so an external index can refer to
+entries by sequence and detect when the ring has wrapped past them. The slot
+array is allocated once at construction — appends never grow or shrink it.
 """
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable, Deque, Generic, List, TypeVar
+from typing import (Callable, Deque, Generic, List, Optional, Tuple, TypeVar)
 
 T = TypeVar("T")
 
@@ -27,3 +33,50 @@ class RingBuffer(Generic[T]):
 
     def __len__(self) -> int:
         return len(self._buf)
+
+
+class SeqRingBuffer(Generic[T]):
+    """Pre-sized ring keyed by monotonically increasing sequence number.
+
+    `append` returns (seq, evicted): the sequence assigned to the new item
+    and whichever item it overwrote (None while the ring is filling), so the
+    caller can keep a by-key index consistent without scanning the ring.
+    `get(seq)` answers None once the ring has wrapped past `seq`.
+    """
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("size must be > 0")
+        self.size = size
+        self._buf: List[Optional[T]] = [None] * size
+        self._next_seq = 0
+
+    @property
+    def next_seq(self) -> int:
+        return self._next_seq
+
+    @property
+    def evicted(self) -> int:
+        """How many items the ring has wrapped past (dropped from history)."""
+        return max(0, self._next_seq - self.size)
+
+    def append(self, item: T) -> Tuple[int, Optional[T]]:
+        seq = self._next_seq
+        slot = seq % self.size
+        old = self._buf[slot]
+        self._buf[slot] = item
+        self._next_seq = seq + 1
+        return seq, old
+
+    def get(self, seq: int) -> Optional[T]:
+        if seq < 0 or seq >= self._next_seq or seq < self._next_seq - self.size:
+            return None
+        return self._buf[seq % self.size]
+
+    def last(self, n: int) -> List[T]:
+        """The most recent min(n, len) items, oldest first."""
+        lo = max(0, self._next_seq - min(max(n, 0), self.size))
+        return [self._buf[s % self.size] for s in range(lo, self._next_seq)]
+
+    def __len__(self) -> int:
+        return min(self._next_seq, self.size)

@@ -1,0 +1,415 @@
+"""Placement flight recorder + scheduler introspection plane (ISSUE 1).
+
+Covers: SeqRingBuffer wraparound; FlightRecorder explain() hit/miss and the
+index staying consistent across wrap; the recorder-disabled config path;
+occupancy math against a known books state; all three balancers reporting
+through the shared base-class hook; and the three /admin/placement/*
+controller endpoints (auth required, JSON shape, 404 after wrap).
+"""
+import asyncio
+import base64
+import time
+
+import aiohttp
+import numpy as np
+import pytest
+
+from openwhisk_tpu.controller.loadbalancer import (LeanBalancer,
+                                                   ShardingBalancer,
+                                                   TpuBalancer)
+from openwhisk_tpu.controller.loadbalancer.flight_recorder import (
+    BatchRecord, FlightRecorder, free_slot_histogram)
+from openwhisk_tpu.core.entity import (ControllerInstanceId, Identity,
+                                       WhiskAuthRecord)
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+from openwhisk_tpu.utils.ring_buffer import SeqRingBuffer
+from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+
+class TestSeqRingBuffer:
+    def test_fill_and_wrap(self):
+        r = SeqRingBuffer(3)
+        assert len(r) == 0 and r.evicted == 0
+        seqs = [r.append(f"i{i}")[0] for i in range(3)]
+        assert seqs == [0, 1, 2]
+        assert len(r) == 3 and r.evicted == 0
+        seq, evicted = r.append("i3")  # wraps: i0 out
+        assert (seq, evicted) == (3, "i0")
+        assert r.evicted == 1
+        assert r.get(0) is None          # wrapped past
+        assert r.get(3) == "i3"
+        assert r.get(99) is None         # never written
+        assert r.last(2) == ["i2", "i3"]
+        assert r.last(10) == ["i1", "i2", "i3"]
+
+    def test_rejects_nonpositive_size(self):
+        with pytest.raises(ValueError):
+            SeqRingBuffer(0)
+
+
+def _one_decision_record(aid, invoker="invoker0"):
+    return BatchRecord(digest={"kernel": "cpu"}, decisions=[
+        (aid, "guest/act", 0, invoker, False, False, 128)])
+
+
+class TestFlightRecorder:
+    def test_explain_hit_and_miss(self):
+        fr = FlightRecorder(size=4)
+        fr.record(_one_decision_record("aid-1"))
+        out = fr.explain("aid-1")
+        assert out["decision"]["activation_id"] == "aid-1"
+        assert out["decision"]["invoker"] == "invoker0"
+        assert out["batch"]["digest"]["kernel"] == "cpu"
+        assert fr.explain("aid-unknown") is None
+
+    def test_wrap_evicts_index(self):
+        fr = FlightRecorder(size=2)
+        for i in range(5):
+            fr.record(_one_decision_record(f"aid-{i}"))
+        assert fr.dropped == 3
+        # wrapped-past activations answer None; live ones still resolve
+        for i in range(3):
+            assert fr.explain(f"aid-{i}") is None
+        for i in (3, 4):
+            assert fr.explain(f"aid-{i}")["decision"]["activation_id"] == f"aid-{i}"
+        # the index never outgrows the live window
+        assert len(fr._index) == 2
+
+    def test_recent_order_and_decision_toggle(self):
+        fr = FlightRecorder(size=8)
+        for i in range(3):
+            fr.record(_one_decision_record(f"aid-{i}"))
+        recs = fr.recent(2)
+        assert [r["seq"] for r in recs] == [1, 2]
+        assert "decisions" in recs[0]
+        slim = fr.recent(2, with_decisions=False)
+        assert "decisions" not in slim[0]
+        assert slim[0]["batch_size"] == 1
+
+    def test_disabled_via_env_config(self, monkeypatch):
+        monkeypatch.setenv(
+            "CONFIG_whisk_loadBalancer_flightRecorder_enabled", "false")
+        monkeypatch.setenv(
+            "CONFIG_whisk_loadBalancer_flightRecorder_size", "17")
+        fr = FlightRecorder.from_config()
+        assert fr.enabled is False
+        assert fr.size == 17
+
+    def test_free_slot_histogram_buckets(self):
+        # 0 slots, 1 slot, 4 slots, 16 slots, 100 slots (slot_mb=128)
+        hist = free_slot_histogram([0, 128, 512, 2048, 12800], 128)
+        # buckets: 0 | 1-2 | 3-4 | 5-8 | 9-16 | 17-32 | 33-64 | >64 slots
+        assert hist == [1, 1, 1, 0, 1, 0, 0, 1]
+        assert sum(hist) == 5
+
+
+class TestTpuBalancerRecording:
+    def test_publish_records_and_explains(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("recorded", memory=256)
+            msgs = [make_msg(action, ident, True) for _ in range(4)]
+            await asyncio.gather(*[
+                await bal.publish(action, m) for m in msgs])
+            fr = bal.flight_recorder
+            ex = fr.explain(msgs[0].activation_id.asString)
+            healthy = bal.metrics.gauge_value("loadbalancer_healthy_invokers")
+            qd = bal.metrics.gauge_value("loadbalancer_placement_queue_depth")
+            occ = bal.metrics.gauge_value("loadbalancer_fleet_occupancy_ratio")
+            dropped = bal.metrics.gauge_value(
+                "loadbalancer_flight_recorder_dropped")
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return ex, healthy, qd, occ, dropped
+
+        ex, healthy, qd, occ, dropped = asyncio.run(go())
+        d = ex["decision"]
+        assert d["invoker"] in ("invoker0", "invoker1")
+        assert d["forced"] is False and d["throttled"] is False
+        assert d["slot_mb"] == 256
+        batch = ex["batch"]
+        assert batch["digest"]["kernel"] in ("xla", "pallas")
+        assert batch["digest"]["healthy_invokers"] == 2
+        assert sum(batch["digest"]["free_slot_hist"]) == 2  # 2 invokers
+        for phase in ("assembly_ms", "dispatch_ms", "readback_ms",
+                      "fanout_ms"):
+            assert phase in batch["timings"]
+        # gauges refreshed per batch
+        assert healthy == 2
+        assert qd is not None and occ is not None and dropped == 0
+
+    def test_ring_wrap_forgets_old_activations(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              batch_window=0.0, max_batch=1)
+            bal.flight_recorder = FlightRecorder(size=2)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("wrapped", memory=128)
+            msgs = [make_msg(action, ident, True) for _ in range(6)]
+            # max_batch=1: every publish is its own batch record
+            for m in msgs:
+                await (await bal.publish(action, m))
+            fr = bal.flight_recorder
+            first = fr.explain(msgs[0].activation_id.asString)
+            last = fr.explain(msgs[-1].activation_id.asString)
+            dropped = fr.dropped
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return first, last, dropped
+
+        first, last, dropped = asyncio.run(go())
+        assert first is None          # wrapped past
+        assert last is not None
+        assert dropped >= 4
+
+    def test_disabled_recorder_records_nothing(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            bal.flight_recorder.enabled = False
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("dark", memory=128)
+            msg = make_msg(action, ident, True)
+            await (await bal.publish(action, msg))
+            n = len(bal.flight_recorder)
+            ex = bal.flight_recorder.explain(msg.activation_id.asString)
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return n, ex
+
+        n, ex = asyncio.run(go())
+        assert n == 0 and ex is None
+
+    def test_occupancy_math_against_known_books(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            await bal.start()
+            # slow invokers keep the placement in flight while we read books
+            invokers, producer = await _fleet(provider, 2, memory_mb=2048,
+                                              delay=0.6)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("occupied", memory=256)
+            promise = await bal.publish(action, make_msg(action, ident, True))
+            mid = bal.occupancy()
+            await promise
+            # drain the release into the books
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+                after = bal.occupancy()
+                if after["fleet"]["used_mb"] == 0:
+                    break
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return mid, after
+
+        mid, after = asyncio.run(go())
+        assert mid["kernel"] in ("xla", "pallas")
+        assert len(mid["invokers"]) == 2
+        assert all(r["capacity_mb"] == 2048 for r in mid["invokers"])
+        # exactly the in-flight 256 MB is held, on exactly one invoker
+        assert mid["fleet"] == {"capacity_mb": 4096, "used_mb": 256,
+                                "occupancy": round(256 / 4096, 4)}
+        held = [r for r in mid["invokers"] if r["used_mb"] == 256]
+        assert len(held) == 1
+        assert held[0]["free_mb"] == 2048 - 256
+        assert held[0]["occupancy"] == round(256 / 2048, 4)
+        # after completion the books are square again
+        assert after["fleet"]["used_mb"] == 0
+
+
+class TestCpuBalancersRecord:
+    def test_sharding_balancer_records_cpu_digest(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = ShardingBalancer(provider, ControllerInstanceId("0"),
+                                   managed_fraction=1.0,
+                                   blackbox_fraction=0.0)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("cpurec", memory=256)
+            msg = make_msg(action, ident, True)
+            await (await bal.publish(action, msg))
+            ex = bal.flight_recorder.explain(msg.activation_id.asString)
+            occ = bal.occupancy()
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return ex, occ
+
+        ex, occ = asyncio.run(go())
+        assert ex["batch"]["digest"]["kernel"] == "cpu"
+        assert ex["batch"]["digest"]["healthy_invokers"] == 2
+        assert ex["decision"]["invoker"] in ("invoker0", "invoker1")
+        assert occ["kernel"] == "cpu"
+        assert len(occ["invokers"]) == 2
+        assert occ["fleet"]["capacity_mb"] == 4096
+
+    def test_lean_balancer_records_cpu_digest(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+
+            class _DummyInvoker:
+                async def stop(self):
+                    pass
+
+            async def factory(invoker_id, messaging_provider):
+                return _DummyInvoker()
+
+            bal = LeanBalancer(provider, ControllerInstanceId("0"), factory)
+            await bal.start()
+            ident = Identity.generate("guest")
+            action = make_action("leanrec", memory=128)
+            msg = make_msg(action, ident, False)
+            await bal.publish(action, msg)
+            ex = bal.flight_recorder.explain(msg.activation_id.asString)
+            occ = bal.occupancy()
+            await bal.close()
+            return ex, occ
+
+        ex, occ = asyncio.run(go())
+        assert ex["batch"]["digest"]["kernel"] == "cpu"
+        assert ex["decision"]["invoker"] == "invoker0"
+        assert occ["kernel"] == "cpu"
+        # the un-acked activation rides in the in-flight occupancy view
+        assert occ["fleet"]["used_mb"] == 128
+
+
+PORT = 13377
+
+
+class TestAdminEndpoints:
+    """The three /admin/placement/* endpoints on a live controller HTTP
+    surface, with a TpuBalancer placing through publish()."""
+
+    def _run(self, scenario):
+        from openwhisk_tpu.controller.core import Controller
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            controller = Controller(ControllerInstanceId("0"), provider,
+                                    load_balancer=bal)
+            ident = Identity.generate("guest")
+            await controller.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await controller.start(port=PORT)
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            hdrs = {"Authorization": "Basic " + base64.b64encode(
+                ident.authkey.compact.encode()).decode()}
+            try:
+                async with aiohttp.ClientSession() as s:
+                    return await scenario(bal, ident, s, hdrs)
+            finally:
+                await controller.stop()
+                for inv in invokers:
+                    await inv.stop()
+
+        return asyncio.run(go())
+
+    def test_auth_required(self):
+        async def scenario(bal, ident, s, hdrs):
+            base = f"http://127.0.0.1:{PORT}/admin/placement"
+            out = {}
+            for path in ("/recent", "/explain/deadbeef", "/occupancy"):
+                async with s.get(base + path) as r:
+                    out[path] = r.status
+            return out
+
+        statuses = self._run(scenario)
+        assert all(v == 401 for v in statuses.values()), statuses
+
+    def test_recent_explain_occupancy_shapes(self):
+        async def scenario(bal, ident, s, hdrs):
+            base = f"http://127.0.0.1:{PORT}/admin/placement"
+            action = make_action("adminseen", memory=256)
+            msgs = [make_msg(action, ident, True) for _ in range(3)]
+            await asyncio.gather(*[
+                await bal.publish(action, m) for m in msgs])
+            out = {}
+            async with s.get(base + "/recent?limit=2", headers=hdrs) as r:
+                out["recent"] = (r.status, await r.json())
+            aid = msgs[0].activation_id.asString
+            async with s.get(base + f"/explain/{aid}", headers=hdrs) as r:
+                out["explain"] = (r.status, await r.json())
+            async with s.get(base + "/explain/notanid", headers=hdrs) as r:
+                out["explain_miss"] = (r.status, await r.json())
+            async with s.get(base + "/occupancy", headers=hdrs) as r:
+                out["occupancy"] = (r.status, await r.json())
+            return out
+
+        out = self._run(scenario)
+        status, recent = out["recent"]
+        assert status == 200
+        assert recent["enabled"] is True and recent["dropped"] == 0
+        assert 1 <= len(recent["records"]) <= 2
+        rec = recent["records"][-1]
+        assert {"seq", "ts", "digest", "timings", "batch_size",
+                "decisions"} <= set(rec)
+        status, ex = out["explain"]
+        assert status == 200
+        assert ex["decision"]["invoker"] in ("invoker0", "invoker1")
+        assert ex["decision"]["forced"] is False
+        assert ex["decision"]["throttled"] is False
+        assert "dispatch_ms" in ex["batch"]["timings"]
+        status, miss = out["explain_miss"]
+        assert status == 404 and "error" in miss
+        status, occ = out["occupancy"]
+        assert status == 200
+        assert len(occ["invokers"]) == 2
+        assert occ["fleet"]["capacity_mb"] == sum(
+            r["capacity_mb"] for r in occ["invokers"])
+
+    def test_explain_404_after_ring_wrap(self):
+        async def scenario(bal, ident, s, hdrs):
+            from openwhisk_tpu.controller.loadbalancer.flight_recorder import \
+                FlightRecorder as FR
+            bal.flight_recorder = FR(size=2)
+            bal.max_batch = 1  # one record per publish
+            base = f"http://127.0.0.1:{PORT}/admin/placement"
+            action = make_action("wrapadmin", memory=128)
+            msgs = [make_msg(action, ident, True) for _ in range(5)]
+            for m in msgs:
+                await (await bal.publish(action, m))
+            out = {}
+            first = msgs[0].activation_id.asString
+            last = msgs[-1].activation_id.asString
+            async with s.get(base + f"/explain/{first}", headers=hdrs) as r:
+                out["first"] = r.status
+            async with s.get(base + f"/explain/{last}", headers=hdrs) as r:
+                out["last"] = (r.status, await r.json())
+            async with s.get(base + "/recent", headers=hdrs) as r:
+                out["recent"] = await r.json()
+            return out
+
+        out = self._run(scenario)
+        assert out["first"] == 404
+        status, ex = out["last"]
+        assert status == 200
+        assert ex["decision"]["activation_id"]
+        assert out["recent"]["dropped"] >= 3

@@ -291,7 +291,7 @@ class TestProviderForBus:
         try:
             from openwhisk_tpu.messaging import MemoryMessagingProvider
             p = provider_for_bus("broker:9092")
-            # Memory takes no bootstrap: the TypeError fallback engages
+            # Memory takes no bootstrap: signature inspection skips the addr
             assert isinstance(p, MemoryMessagingProvider)
         finally:
             spi.reset()

@@ -108,6 +108,51 @@ class TestDeviceAdmissionInBalancer:
         assert throttle_count == 7
         assert leaked == 0 and free_ok
 
+    def test_overflow_namespaces_stay_in_shared_subrange(self):
+        """Regression (ISSUE 1 satellite): once the dedicated rate buckets
+        fill, overflow namespaces must hash into the RESERVED shared tail
+        sub-range — never onto a dedicated tenant's bucket, where their
+        traffic would drain that tenant's tokens."""
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.core.entity import ControllerInstanceId
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+
+        bal = TpuBalancer(MemoryMessagingProvider(),
+                          ControllerInstanceId("0"),
+                          rate_limit_per_minute=60)
+        dedicated = bal.RATE_NS_BUCKETS - bal.RATE_NS_SHARED_BUCKETS
+        for i in range(dedicated):
+            assert bal._ns_slot(f"tenant{i}") == i  # dedicated, memoized
+        # every overflow namespace lands in [dedicated, RATE_NS_BUCKETS)
+        overflow_slots = {bal._ns_slot(f"overflow{i}") for i in range(500)}
+        assert all(dedicated <= s < bal.RATE_NS_BUCKETS
+                   for s in overflow_slots)
+        # dedicated tenants keep their original buckets
+        assert bal._ns_slot("tenant0") == 0
+        assert bal._ns_slot(f"tenant{dedicated - 1}") == dedicated - 1
+
+    def test_bucket_state_survives_rebuilds(self):
+        """Regression (ISSUE 1 satellite): _build_packed_fns must CARRY the
+        live token-bucket state through kernel swaps / growth rebuilds —
+        re-initializing would grant a fresh full burst mid-minute."""
+        import numpy as np
+
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.core.entity import ControllerInstanceId
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+
+        bal = TpuBalancer(MemoryMessagingProvider(),
+                          ControllerInstanceId("0"),
+                          rate_limit_per_minute=60)
+        st = bal._bucket_state
+        assert st is not None
+        # drain the buckets, then force the rebuild paths
+        bal._bucket_state = st._replace(tokens=st.tokens * 0.0)
+        bal.update_cluster(2)            # _init_device_state -> rebuild
+        assert float(np.asarray(bal._bucket_state.tokens).max()) == 0.0
+        bal._use_xla_kernels()           # kernel swap -> rebuild
+        assert float(np.asarray(bal._bucket_state.tokens).max()) == 0.0
+
     def test_refill_readmits_like_rate_window(self):
         """After the window passes, the budget returns (RateThrottler's
         rolling-minute behavior; the bucket refills continuously at
