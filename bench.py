@@ -260,7 +260,8 @@ async def _echo_fleet(provider, n_invokers):
 
 def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                     concurrency: int = 64, kernel: str = "auto",
-                    flight_recorder: bool = True) -> dict:
+                    flight_recorder: bool = True,
+                    telemetry: bool = True) -> dict:
     """TpuBalancer.publish() end-to-end on the in-memory bus with echo
     invokers: the full host path (slot alloc, micro-batch assembly, device
     step, promise fan-out, bus send) that the raw kernel number omits."""
@@ -279,6 +280,7 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                           managed_fraction=1.0, blackbox_fraction=0.0,
                           kernel=kernel)
         bal.flight_recorder.enabled = flight_recorder
+        bal.telemetry.enabled = telemetry
         await bal.start()
         feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
         # wait until supervision has actually registered the fleet (a fixed
@@ -553,6 +555,35 @@ def _flight_recorder_overhead(repeats: int = 3, total: int = 1000,
         return None
 
 
+def _telemetry_overhead(repeats: int = 3, total: int = 1000,
+                        concurrency: int = 64) -> Optional[dict]:
+    """The device-telemetry tax: median XLA-kernel placement rate through
+    the full balancer path with the latency accumulator ON vs OFF. The
+    accumulator lives on the completion/dispatch path (observe() per ack +
+    one scatter-add fold per batch), so the balancer-level rate is where
+    its cost can show. Acceptance gate: overhead_pct <= 5 (ISSUE 2)."""
+    try:
+        on_rates, off_rates = [], []
+        for _ in range(repeats):
+            on_rates.append(_balancer_bench(
+                total=total, concurrency=concurrency, kernel="xla",
+                telemetry=True)["activations_per_sec"])
+            off_rates.append(_balancer_bench(
+                total=total, concurrency=concurrency, kernel="xla",
+                telemetry=False)["activations_per_sec"])
+        on = statistics.median(on_rates)
+        off = statistics.median(off_rates)
+        return {
+            "rate_telemetry_on": round(on, 1),
+            "rate_telemetry_off": round(off, 1),
+            "overhead_pct": round(100.0 * (off - on) / off, 2) if off else None,
+            "repeats": repeats,
+        }
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        print(f"# telemetry_overhead failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _cpu_oracle_rate(n: int = N_INVOKERS, reqs: int = 2048) -> float:
     from openwhisk_tpu.models.sharding_policy import (ShardingPolicyState,
                                                       release, schedule)
@@ -630,8 +661,10 @@ def main() -> None:
     balancer = None
     balancer_host = None
     recorder_overhead = None
+    telemetry_overhead = None
     if not args.quick:
         recorder_overhead = _flight_recorder_overhead()
+        telemetry_overhead = _telemetry_overhead()
         rows = _balancer_rows()
         # c64 stays flattened at the top level (older readers); the rows
         # dict carries the per-concurrency detail + phase breakdowns
@@ -714,6 +747,8 @@ def main() -> None:
         out["balancer_host_path"] = balancer_host
     if recorder_overhead is not None:
         out["flight_recorder_overhead"] = recorder_overhead
+    if telemetry_overhead is not None:
+        out["telemetry_overhead"] = telemetry_overhead
     if multi:
         out["multi_controller"] = multi
     print(json.dumps(out))

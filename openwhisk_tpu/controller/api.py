@@ -117,6 +117,9 @@ class ControllerApi:
         r.add_get("/admin/placement/explain/{activation_id}",
                   self.placement_explain)
         r.add_get("/admin/placement/occupancy", self.placement_occupancy)
+        # SLO plane: compliance / budget / burn rates from the balancer's
+        # telemetry accumulator, auth-gated like the placement endpoints
+        r.add_get("/admin/slo", self.slo_report)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -323,8 +326,11 @@ class ControllerApi:
         return web.json_response(body)
 
     async def metrics(self, request):
-        return web.Response(text=self.c.metrics.prometheus_text(),
-                            content_type="text/plain")
+        # worker thread: the balancer's telemetry renderer reads the
+        # device-accumulated histogram counts, which forces a device->host
+        # sync that must not stall the event loop mid-step
+        text = await asyncio.to_thread(self.c.metrics.prometheus_text)
+        return web.Response(text=text, content_type="text/plain")
 
     # ------------------------------------------- placement introspection
     def _flight_recorder(self):
@@ -366,6 +372,27 @@ class ControllerApi:
                 "by this controller, recorder disabled, or the ring has "
                 "wrapped past it)", request.get("transid"))
         return web.json_response(found)
+
+    async def slo_report(self, request):
+        """Is the fleet meeting its latency/error SLOs, and which invokers
+        or tenants are burning the budget: the telemetry plane's evaluation
+        of the `CONFIG_whisk_slo_*` targets against the accumulated
+        per-invoker / per-namespace latency buckets."""
+        tp = getattr(self.c.load_balancer, "telemetry", None)
+        if tp is None:
+            return _error(404, "this balancer has no telemetry plane",
+                          request.get("transid"))
+        names = []
+        lb = self.c.load_balancer
+        if hasattr(lb, "_telemetry_invoker_names"):
+            names = lb._telemetry_invoker_names()
+        if tp.SYNCS_DEVICE:
+            # reading device counts forces a device sync — worker thread,
+            # same policy as the occupancy endpoint
+            report = await asyncio.to_thread(tp.slo_report, names)
+        else:
+            report = tp.slo_report(names)
+        return web.json_response(report)
 
     async def placement_occupancy(self, request):
         """Per-invoker slots-in-use/capacity derived from the balancer

@@ -6,6 +6,7 @@ from .flight_recorder import (BatchRecord, FlightRecorder,
                               FlightRecorderConfig)
 from .lean import LeanBalancer, LeanBalancerProvider
 from .supervision import InvokerPool
+from .telemetry import SloConfig, TelemetryConfig, TelemetryPlane
 from .sharding_balancer import ShardingBalancer, ShardingBalancerProvider
 from .tpu_balancer import TpuBalancer, TpuBalancerProvider
 

@@ -18,6 +18,7 @@ SPI) and CommonLoadBalancer.scala (the bookkeeping every balancer shares):
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Union
 
@@ -28,7 +29,9 @@ from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
                                   parse_ack)
 from ...utils.logging import MetricEmitter
 from ...utils.transaction import TransactionId
+from ...ops.telemetry import (OUTCOME_ERROR, OUTCOME_SUCCESS, OUTCOME_TIMEOUT)
 from .flight_recorder import BatchRecord, FlightRecorder
+from .telemetry import TelemetryPlane
 
 # invoker states (ref InvokerState in InvokerSupervision.scala)
 HEALTHY = "up"
@@ -78,6 +81,8 @@ class ActivationEntry:
     action_key: str
     is_blackbox: bool
     is_blocking: bool
+    #: monotonic stamp at setup — the telemetry plane's e2e latency base
+    t_start: float = 0.0
     #: forced-timeout timer (a TimerHandle; .cancel() like a Task)
     timeout_task: Optional[asyncio.TimerHandle] = None
     promise: Optional[asyncio.Future] = None
@@ -140,7 +145,8 @@ class CommonLoadBalancer(LoadBalancer):
 
     def __init__(self, messaging_provider, controller_instance, logger=None,
                  metrics: Optional[MetricEmitter] = None,
-                 flight_recorder: Optional[FlightRecorder] = None):
+                 flight_recorder: Optional[FlightRecorder] = None,
+                 telemetry: Optional[TelemetryPlane] = None):
         self.provider = messaging_provider
         self.controller = controller_instance
         self.logger = logger
@@ -156,6 +162,14 @@ class CommonLoadBalancer(LoadBalancer):
         # /admin/placement/* endpoints are backend-agnostic
         self.flight_recorder = (flight_recorder if flight_recorder is not None
                                 else FlightRecorder.from_config())
+        # the shared telemetry plane (same hook pattern): completion
+        # latencies/outcomes accumulate per invoker x namespace — on device
+        # for the TPU balancer, in the NumPy twin for CPU balancers — and
+        # render as Prometheus histogram families on this emitter's page
+        self.telemetry = (telemetry if telemetry is not None
+                          else TelemetryPlane.from_config())
+        self._telemetry_renderer = self._telemetry_exposition
+        self.metrics.register_renderer(self._telemetry_renderer)
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -250,6 +264,7 @@ class CommonLoadBalancer(LoadBalancer):
             action_key=f"{action.fully_qualified_name}@{action.rev.rev or ''}",
             is_blackbox=action.exec_metadata().is_blackbox,
             is_blocking=msg.blocking,
+            t_start=time.monotonic(),
             promise=promise,
         )
         # call_later, not a task per activation: a TimerHandle is one heap
@@ -332,6 +347,7 @@ class CommonLoadBalancer(LoadBalancer):
                     entry.promise.set_exception(ActiveAckTimeout(aid))
             else:
                 self.metrics.counter("loadbalancer_completion_ack_regular")
+            self._telemetry_observe(entry, invoker, forced, is_system_error)
             self.on_invocation_finished(invoker or (entry.invoker if entry else None),
                                         is_system_error=is_system_error,
                                         forced=forced)
@@ -375,6 +391,37 @@ class CommonLoadBalancer(LoadBalancer):
                            d.get("healthy_invokers", 0))
         self.metrics.gauge("loadbalancer_flight_recorder_dropped", fr.dropped)
 
+    # -- telemetry plane (shared hook, like the flight recorder) -----------
+    def _telemetry_observe(self, entry: ActivationEntry,
+                           invoker: Optional[InvokerInstanceId],
+                           forced: bool, is_system_error: bool) -> None:
+        """Feed one completion into the latency/outcome accumulator. The
+        e2e latency is setup->completion-ack; entries restored without a
+        stamp (pre-upgrade snapshots) are skipped rather than polluting the
+        +Inf bucket."""
+        tp = self.telemetry
+        if not tp.enabled or entry.t_start <= 0.0:
+            return
+        inv = invoker or entry.invoker
+        if inv is None:
+            return
+        outcome = (OUTCOME_ERROR if is_system_error
+                   else OUTCOME_TIMEOUT if forced else OUTCOME_SUCCESS)
+        tp.observe(inv.instance, entry.namespace_id,
+                   (time.monotonic() - entry.t_start) * 1e3, outcome)
+        # balancers without a supervision scheduler (lean) refresh the burn
+        # gauges off the completion stream; tick() is internally 1 Hz-capped
+        tp.maybe_tick(self.metrics)
+
+    def _telemetry_invoker_names(self) -> List[str]:
+        """Invoker labels for the exposition/SLO surfaces, index-aligned
+        with the accumulator's invoker axis."""
+        registry = getattr(self, "_registry", None)
+        return [inv.as_string for inv in registry] if registry else []
+
+    def _telemetry_exposition(self) -> str:
+        return self.telemetry.prometheus_text(self._telemetry_invoker_names())
+
     # -- subclass hooks ----------------------------------------------------
     def release_invoker(self, invoker: InvokerInstanceId, entry: ActivationEntry) -> None:
         """Return the capacity slot taken for this activation."""
@@ -390,3 +437,6 @@ class CommonLoadBalancer(LoadBalancer):
             if entry.timeout_task:
                 entry.timeout_task.cancel()
         self.activation_slots.clear()
+        # shared (process-wide) emitters outlive the balancer: stop
+        # contributing telemetry families once closed
+        self.metrics.unregister_renderer(self._telemetry_renderer)

@@ -45,6 +45,12 @@ class LeanBalancer(CommonLoadBalancer):
     async def invoker_health(self) -> List[InvokerHealth]:
         return [InvokerHealth(self.invoker_id, HEALTHY)]
 
+    def _telemetry_invoker_names(self) -> List[str]:
+        # no registry in lean mode: the single in-process invoker. Burn-rate
+        # gauges refresh off the completion stream (base maybe_tick) since
+        # there is no supervision watchdog to ride.
+        return [self.invoker_id.as_string]
+
     def occupancy(self) -> dict:
         """Lean mode has no capacity books (the in-process invoker's pool
         buffers pressure): report in-flight activation memory against the

@@ -28,9 +28,12 @@ class ShardingBalancer(CommonLoadBalancer):
             [], cluster_size=cluster_size, managed_fraction=managed_fraction,
             blackbox_fraction=blackbox_fraction)
         # per-controller group: each controller keeps its own full ping view
+        # (on_tick refreshes the telemetry plane's SLO burn-rate gauges on
+        # the same 1 Hz watchdog the TPU balancer uses)
         self.supervision = InvokerPool(
             messaging_provider, on_status_change=self._status_change,
-            logger=logger, group=f"health-{controller_instance.as_string}")
+            logger=logger, group=f"health-{controller_instance.as_string}",
+            on_tick=lambda: self.telemetry.tick(self.metrics))
         self._registry: List[InvokerInstanceId] = []
         self._usable: List[bool] = []
 

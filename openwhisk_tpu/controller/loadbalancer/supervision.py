@@ -66,10 +66,14 @@ class InvokerPool:
                  on_status_change: Optional[Callable] = None,
                  send_test_action: Optional[Callable] = None,
                  logger=None, ping_timeout: float = PING_TIMEOUT_S,
-                 group: str = "health"):
+                 group: str = "health", on_tick: Optional[Callable] = None):
         self.provider = messaging_provider
         self.on_status_change = on_status_change or (lambda inv, status: None)
         self.send_test_action = send_test_action
+        #: optional 1 Hz callback riding the watchdog — the balancer hangs
+        #: its telemetry burn-rate gauge refresh here so dashboards stay
+        #: fresh without a scheduler of their own
+        self.on_tick = on_tick
         self.logger = logger
         self.ping_timeout = ping_timeout
         self.group = group
@@ -140,6 +144,11 @@ class InvokerPool:
         for st in self.invokers.values():
             if st.status != OFFLINE and now - st.last_ping > self.ping_timeout:
                 self._transition(st, OFFLINE)
+        if self.on_tick is not None:
+            try:
+                self.on_tick()
+            except Exception:  # noqa: BLE001 — a gauge refresh must never
+                pass           # kill the health watchdog
 
     def _maybe_recover(self, st: InvokerActorState) -> None:
         now = time.monotonic()

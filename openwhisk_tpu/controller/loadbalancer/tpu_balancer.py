@@ -242,8 +242,21 @@ class TpuBalancer(CommonLoadBalancer):
         # controllers; ref: each controller runs its own InvokerPool)
         self.supervision = InvokerPool(
             messaging_provider, on_status_change=self._status_change,
-            logger=logger, group=f"health-{controller_instance.as_string}")
+            logger=logger, group=f"health-{controller_instance.as_string}",
+            on_tick=self._telemetry_tick)
+        # completion telemetry accumulates ON DEVICE for this balancer: the
+        # buffered event rows fold into the accumulator as one scatter-add
+        # per dispatch cycle (_dispatch_batch / idle _device_step)
+        if self.telemetry.enabled:
+            self.telemetry.use_device(self._n_pad)
         self._recompute_partitions()
+
+    def _telemetry_tick(self) -> None:
+        # the supervision watchdog also drains completion events that
+        # arrived while no placement traffic was flowing (idle fleets must
+        # still converge their device counts)
+        self.telemetry.device_fold()
+        self.telemetry.tick(self.metrics)
 
     # -- device state ------------------------------------------------------
     def _resolve_kernel(self) -> str:
@@ -738,6 +751,11 @@ class TpuBalancer(CommonLoadBalancer):
     #: tunneled device serialize rather than pipeline)
     RTT_FAST_MS = 5.0
 
+    #: don't pay a telemetry-fold dispatch on the hot path for fewer than
+    #: this many buffered completion events; the supervision tick and the
+    #: scrape-time drain pick up the tail within a second
+    TELEMETRY_FOLD_MIN = 64
+
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
         """Pad batch sizes to power-of-two buckets so the jitted kernels see
@@ -802,6 +820,13 @@ class TpuBalancer(CommonLoadBalancer):
                 ups, self._health_updates = self._health_updates, {}
                 self.state = set_health(self.state, list(ups.keys()),
                                         list(ups.values()))
+            try:
+                self.telemetry.device_fold()
+            except Exception as e:  # noqa: BLE001 — a telemetry failure
+                # must not kill the flush task (stranding queued releases)
+                if self.logger:
+                    self.logger.warn(None, f"telemetry fold failed: {e!r}",
+                                     "TpuBalancer")
             return
 
         # bound dispatched-but-unread steps (capacity freed by the readback
@@ -878,6 +903,20 @@ class TpuBalancer(CommonLoadBalancer):
                                   "TpuBalancer")
             return
 
+        # completion telemetry rides the SAME dispatch cycle: at most one
+        # extra scatter-add program per batch over event rows already packed
+        # host-side — asynchronous like the step itself, no readback (counts
+        # stay on device until a scrape). Small tails are left for the 1 Hz
+        # supervision tick / scrape-time drain instead of paying a dispatch
+        # for a near-empty fold on every micro-batch.
+        try:
+            if self.telemetry.pending >= self.TELEMETRY_FOLD_MIN:
+                self.telemetry.device_fold()
+        except Exception as e:  # noqa: BLE001 — telemetry must never take
+            # the placement path down with it
+            if self.logger:
+                self.logger.warn(None, f"telemetry fold failed: {e!r}",
+                                 "TpuBalancer")
         # phase breakdown (bench + ops visibility): assembly is host numpy
         # packing, dispatch is the jit enqueue (transfers + program launch)
         t_dispatched = time.monotonic()
@@ -919,6 +958,11 @@ class TpuBalancer(CommonLoadBalancer):
             self.metrics.histogram("loadbalancer_tpu_readback_ms", rb_ms)
             # benign cross-thread write: a float EWMA steering a heuristic
             self._rtt_ewma_ms = 0.8 * self._rtt_ewma_ms + 0.2 * rb_ms
+            # the EWMA silently flips the eager-vs-batched dispatch policy
+            # at RTT_FAST_MS — exported so operators can SEE which regime
+            # the balancer is in (not just infer it from latency shifts)
+            self.metrics.gauge("loadbalancer_readback_rtt_ms",
+                               self._rtt_ewma_ms)
             if rec is not None:
                 # books digest off the POST-step free_mb captured at
                 # dispatch: the transfer happens here on the worker thread

@@ -15,10 +15,56 @@ from typing import Optional
 
 from ..messaging.connector import MessageFeed
 from ..messaging.message import EventMessage
-from ..utils.logging import MetricEmitter
+from ..utils.logging import MetricEmitter, _prom_label_value
 from ..utils.tasks import wait_for_shutdown
 
 EVENTS_TOPIC = "events"
+
+
+# -- Prometheus exposition of accumulated counts ---------------------------
+# The balancer telemetry plane (loadbalancer/telemetry.py) accumulates
+# latency bucket counts on device / in numpy; THESE helpers own how they
+# render as real Prometheus `histogram` families (cumulative `le` buckets,
+# `_sum`/`_count`) and counter families on the controller's /metrics page
+# (MetricEmitter renderer hook). Bounds arrive in ms; the wire format is
+# seconds, per Prometheus base-unit conventions.
+
+def _labels(d: dict) -> str:
+    return ",".join(f'{k}="{_prom_label_value(v)}"'
+                    for k, v in sorted(d.items()))
+
+
+def histogram_family_text(family: str, label_name: str, rows,
+                          bounds_ms) -> list:
+    """Render one histogram family. `rows` yields (label_value,
+    per-bucket counts [B], latency_sum_ms); counts are PER-bucket — the
+    cumulative `le` semantics happen here, and the last (overflow) bucket
+    becomes `+Inf`, equal to `_count` as the format requires."""
+    rows = list(rows)
+    if not rows:
+        return []
+    out = [f"# TYPE {family} histogram"]
+    les = [f"{b / 1000.0:g}" for b in bounds_ms] + ["+Inf"]
+    for value, counts, sum_ms in rows:
+        lbl = _labels({label_name: value})
+        cum = 0
+        for le, cnt in zip(les, counts):
+            cum += int(cnt)
+            out.append(f'{family}_bucket{{{lbl},le="{le}"}} {cum}')
+        out.append(f"{family}_sum{{{lbl}}} {float(sum_ms) / 1000.0:g}")
+        out.append(f"{family}_count{{{lbl}}} {cum}")
+    return out
+
+
+def counter_family_text(family: str, rows) -> list:
+    """Render one counter family from (label_dict, value) pairs."""
+    rows = list(rows)
+    if not rows:
+        return []
+    out = [f"# TYPE {family} counter"]
+    for labels, value in rows:
+        out.append(f"{family}{{{_labels(labels)}}} {value}")
+    return out
 
 
 class UserEventsRecorder:

@@ -71,7 +71,11 @@ async def make_standalone(port: int = 3233, artifact_store=None,
                          metrics=logger.metrics,
                          managed_fraction=1.0, blackbox_fraction=0.0)
     else:
+        # metrics=logger.metrics: the controller serves this emitter at
+        # /metrics — sharing it puts the lean balancer's counters AND its
+        # telemetry histogram families on the scrape page
         lb = LeanBalancer(provider, instance, invoker_factory, logger=logger,
+                          metrics=logger.metrics,
                           user_memory=MB(user_memory_mb))
     if ui and "extra_routes" not in controller_kw:
         # playground dev UI beside /api/v1 (ref standalone PlaygroundLauncher)

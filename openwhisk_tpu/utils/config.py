@@ -85,6 +85,12 @@ def _coerce(tp, value):
         return value
     if dataclasses.is_dataclass(tp) and isinstance(value, dict):
         return load_config(tp, value)
+    if tp is dict:
+        # bare `dict` fields (no typing origin): env values arrive as JSON
+        # strings, e.g. CONFIG_whisk_slo_overrides='{"ns": {...}}'
+        if isinstance(value, str):
+            value = json.loads(value)
+        return dict(value)
     if tp is bool:
         if isinstance(value, str):
             return value.strip().lower() in ("1", "true", "yes", "on")

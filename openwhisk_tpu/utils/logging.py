@@ -26,18 +26,28 @@ def _mkey(name: str, tags):
 class MetricEmitter:
     """Thread-safe counters / histograms / gauges (ref Logging.scala:241-258).
 
-    Histograms keep (count, sum, min, max) plus a small reservoir for
-    percentile estimates — enough for the /metrics endpoint and tests.
-    Every method takes optional `tags` (a flat str->str dict): tagged series
-    share the family name and differ by label set, exactly Prometheus's
-    model.
+    Histograms keep (count, sum, min, max) plus a sliding window of the
+    last WINDOW samples for windowed percentile estimates — enough for the
+    /metrics endpoint and tests. Every method takes optional `tags` (a flat
+    str->str dict): tagged series share the family name and differ by label
+    set, exactly Prometheus's model.
+
+    `register_renderer(fn)` attaches extra exposition blocks (e.g. the
+    balancer telemetry plane's device-accumulated histogram families) that
+    prometheus_text() appends to the page, so every scrape surface sharing
+    this emitter serves them without new wiring.
     """
+
+    #: sliding-window size for percentile estimates
+    WINDOW = 1024
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[tuple, int] = defaultdict(int)
         self._gauges: dict[tuple, float] = {}
-        self._hist: dict[tuple, list] = {}  # key -> [count, sum, min, max, reservoir]
+        # key -> [count, sum, min, max, window, cursor]
+        self._hist: dict[tuple, list] = {}
+        self._renderers: list = []
 
     def counter(self, name: str, delta: int = 1, tags=None) -> None:
         with self._lock:
@@ -51,17 +61,37 @@ class MetricEmitter:
         with self._lock:
             h = self._hist.get(_mkey(name, tags))
             if h is None:
-                h = [0, 0.0, float("inf"), float("-inf"), []]
+                h = [0, 0.0, float("inf"), float("-inf"), [], 0]
                 self._hist[_mkey(name, tags)] = h
             h[0] += 1
             h[1] += value
             h[2] = min(h[2], value)
             h[3] = max(h[3], value)
             res = h[4]
-            if len(res) < 1024:
+            if len(res) < self.WINDOW:
                 res.append(value)
-            else:  # reservoir-replace
-                res[h[0] % 1024] = value
+            else:
+                # honest sliding window: overwrite the OLDEST sample via a
+                # dedicated write cursor (keying on total count would skip
+                # or double-hit slots whenever count and window drift)
+                res[h[5]] = value
+                h[5] = (h[5] + 1) % self.WINDOW
+
+    def register_renderer(self, render_fn) -> None:
+        """Append `render_fn()` (exposition-format text) to every
+        prometheus_text() page."""
+        with self._lock:
+            self._renderers.append(render_fn)
+
+    def unregister_renderer(self, render_fn) -> None:
+        """Detach a renderer (a closed balancer must stop contributing —
+        on a shared process-wide emitter a stale renderer would keep the
+        balancer alive and duplicate its families on the page)."""
+        with self._lock:
+            try:
+                self._renderers.remove(render_fn)
+            except ValueError:
+                pass
 
     # -- read side ---------------------------------------------------------
     def counter_value(self, name: str, tags=None) -> int:
@@ -73,6 +103,9 @@ class MetricEmitter:
             return self._gauges.get(_mkey(name, tags))
 
     def histogram_stats(self, name: str, tags=None) -> Optional[dict]:
+        """count/sum/min/max are lifetime; p50/p99 are WINDOWED percentiles
+        over the last WINDOW samples (the sliding window above), so they
+        track current behavior rather than boot-to-now history."""
         with self._lock:
             h = self._hist.get(_mkey(name, tags))
             if not h or not h[0]:
@@ -90,7 +123,11 @@ class MetricEmitter:
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
-                "histograms": {k: {"count": v[0], "sum": v[1]} for k, v in self._hist.items()},
+                "histograms": {
+                    k: {"count": v[0], "sum": v[1],
+                        "p50": _window_pctl(v[4], 0.5),
+                        "p99": _window_pctl(v[4], 0.99)}
+                    for k, v in self._hist.items()},
             }
 
     def prometheus_text(self) -> str:
@@ -112,6 +149,15 @@ class MetricEmitter:
         emit(snap["gauges"], "gauge", lambda s_, v: f"{s_} {v}")
         emit(snap["histograms"], "summary",
              lambda s_, v: _summary_lines(s_, v))
+        with self._lock:
+            renderers = list(self._renderers)
+        for render in renderers:
+            try:
+                text = render()
+            except Exception:  # noqa: BLE001 — one broken renderer must
+                continue      # not take the whole scrape page down
+            if text:
+                out.append(text.rstrip("\n"))
         return "\n".join(out) + "\n"
 
 
@@ -136,12 +182,35 @@ def _prom_series(key) -> str:
     return n
 
 
+def _window_pctl(window, q: float):
+    if not window:
+        return None
+    res = sorted(window)
+    return res[min(len(res) - 1, int(len(res) * q))]
+
+
 def _summary_lines(series: str, v: dict) -> str:
-    # suffix goes on the NAME, before any label block
+    # suffix goes on the NAME, before any label block; quantile lines carry
+    # the windowed p50/p99 (histogram_stats already computed them — without
+    # these lines Grafana latency panels need recording rules over _sum)
+    lines = []
     if "{" in series:
         n, lbl = series.split("{", 1)
-        return f"{n}_count{{{lbl} {v['count']}\n{n}_sum{{{lbl} {v['sum']}"
-    return f"{series}_count {v['count']}\n{series}_sum {v['sum']}"
+        lbl = lbl[:-1]  # strip the closing brace; each line re-adds it
+        for q in (0.5, 0.99):
+            p = v.get(f"p{int(q * 100)}")
+            if p is not None:
+                lines.append(f'{n}{{{lbl},quantile="{q}"}} {p}')
+        lines.append(f"{n}_count{{{lbl}}} {v['count']}")
+        lines.append(f"{n}_sum{{{lbl}}} {v['sum']}")
+    else:
+        for q in (0.5, 0.99):
+            p = v.get(f"p{int(q * 100)}")
+            if p is not None:
+                lines.append(f'{series}{{quantile="{q}"}} {p}')
+        lines.append(f"{series}_count {v['count']}")
+        lines.append(f"{series}_sum {v['sum']}")
+    return "\n".join(lines)
 
 
 class Logging:

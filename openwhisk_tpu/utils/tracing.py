@@ -48,13 +48,22 @@ class Reporter:
 
 
 class BufferReporter(Reporter):
+    """In-memory span sink. A full buffer DROPS new spans — counted (like
+    ZipkinReporter.dropped_spans), so a saturated buffer is visible to
+    tests/operators instead of silently lossy."""
+
     def __init__(self, max_spans: int = 10_000):
         self.spans: List[Span] = []
         self.max_spans = max_spans
+        self.sent_spans = 0
+        self.dropped_spans = 0
 
     def report(self, span: Span) -> None:
         if len(self.spans) < self.max_spans:
             self.spans.append(span)
+            self.sent_spans += 1
+        else:
+            self.dropped_spans += 1
 
 
 class ZipkinReporter(Reporter):

@@ -1,0 +1,209 @@
+"""Prometheus text-exposition validator over a live /metrics page.
+
+Fetches the controller's /metrics with a TpuBalancer placing real
+activations (so the page carries counters, gauges, summaries with quantile
+lines AND the telemetry plane's device-accumulated histogram families) and
+checks every line against the exposition-format grammar: TYPE lines, metric
+name / label name charsets, label-value escaping, and — for histogram
+families — strictly increasing `le` bounds, monotone non-decreasing
+cumulative bucket counts, and a `+Inf` bucket equal to `_count`.
+"""
+import asyncio
+import base64
+import re
+
+import aiohttp
+
+from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+from openwhisk_tpu.core.entity import (ControllerInstanceId, Identity,
+                                       WhiskAuthRecord)
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+PORT = 13379
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+"
+    r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|[+-]Inf|NaN)$")
+
+
+def parse_labels(body: str) -> dict:
+    """Parse a label block body ('a="x",b="y"') honoring \\\\, \\" and \\n
+    escapes — a hand parser, because naive comma-splitting breaks on
+    escaped quotes inside values."""
+    labels = {}
+    i = 0
+    while i < len(body):
+        eq = body.index("=", i)
+        name = body[i:eq]
+        assert body[eq + 1] == '"', f"unquoted label value near {body[i:]}"
+        j = eq + 2
+        val = []
+        while body[j] != '"':
+            if body[j] == "\\":
+                assert body[j + 1] in ('\\', '"', 'n'), \
+                    f"bad escape \\{body[j + 1]}"
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[body[j + 1]])
+                j += 2
+            else:
+                assert body[j] != "\n"
+                val.append(body[j])
+                j += 1
+        labels[name] = "".join(val)
+        i = j + 1
+        if i < len(body):
+            assert body[i] == ",", f"expected ',' near {body[i:]}"
+            i += 1
+    return labels
+
+
+def validate_exposition(text: str) -> dict:
+    """Full-grammar pass over one exposition page. Returns
+    {family: type} plus the parsed histogram groups for extra checks."""
+    types = {}
+    samples = []  # (name, labels, value)
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = re.match(r"^# (TYPE|HELP) ([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\s+(.*))?$",
+                         line)
+            assert m, f"malformed comment line: {line!r}"
+            if m.group(1) == "TYPE":
+                fam, kind = m.group(2), (m.group(3) or "").strip()
+                assert kind in ("counter", "gauge", "histogram", "summary",
+                                "untyped"), line
+                assert fam not in types, f"duplicate TYPE for {fam}"
+                types[fam] = kind
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, label_body, value = m.groups()
+        assert _NAME.match(name), name
+        labels = parse_labels(label_body) if label_body else {}
+        for ln in labels:
+            assert _LABEL_NAME.match(ln), ln
+        samples.append((name, labels, float(value)))
+
+    # every sample belongs to a declared family (TYPE precedes samples in
+    # this exposition: emitters declare per family before rendering)
+    def family_of(name):
+        if name in types:
+            return name
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                return name[: -len(suffix)]
+        return None
+
+    for name, labels, _ in samples:
+        fam = family_of(name)
+        assert fam is not None, f"sample {name} has no TYPE line"
+        if "quantile" in labels:
+            assert types[fam] == "summary", (name, types[fam])
+        if "le" in labels:
+            assert types[fam] == "histogram", (name, types[fam])
+
+    # histogram semantics: per-series monotone cumulative le buckets,
+    # +Inf present and equal to _count
+    hist = {}
+    counts = {}
+    for name, labels, value in samples:
+        fam = family_of(name)
+        if types.get(fam) != "histogram":
+            continue
+        key_labels = tuple(sorted((k, v) for k, v in labels.items()
+                                  if k != "le"))
+        if name.endswith("_bucket"):
+            le = labels["le"]
+            hist.setdefault((fam, key_labels), []).append(
+                (float("inf") if le == "+Inf" else float(le), value))
+        elif name.endswith("_count"):
+            counts[(fam, key_labels)] = value
+    assert hist, "no histogram families on the page"
+    for key, buckets in hist.items():
+        les = [b[0] for b in buckets]
+        assert les == sorted(les) and len(set(les)) == len(les), \
+            f"le bounds not strictly increasing for {key}"
+        assert les[-1] == float("inf"), f"missing +Inf bucket for {key}"
+        cums = [b[1] for b in buckets]
+        assert all(a <= b for a, b in zip(cums, cums[1:])), \
+            f"cumulative counts not monotone for {key}: {cums}"
+        assert key in counts and counts[key] == cums[-1], \
+            f"+Inf bucket != _count for {key}"
+    return {"types": types, "histograms": hist}
+
+
+class TestExpositionFormat:
+    def test_unit_validator_rejects_garbage(self):
+        import pytest
+        with pytest.raises(AssertionError):
+            validate_exposition("bad-metric-name 1\n")
+        with pytest.raises(AssertionError):
+            validate_exposition(
+                "# TYPE f histogram\n"
+                'f_bucket{le="1"} 5\nf_bucket{le="+Inf"} 3\nf_count 3\n')
+
+    def test_live_metrics_page_is_valid(self):
+        from openwhisk_tpu.controller.core import Controller
+
+        async def go():
+            from openwhisk_tpu.utils.logging import NullLogging
+            provider = MemoryMessagingProvider()
+            # share one emitter between balancer and controller, the way
+            # the production assemblies wire it (metrics=logger.metrics) —
+            # that is what puts the telemetry renderer on the /metrics page
+            logger = NullLogging()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              logger=logger, metrics=logger.metrics,
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            controller = Controller(ControllerInstanceId("0"), provider,
+                                    logger=logger, load_balancer=bal)
+            ident = Identity.generate("guest")
+            await controller.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await controller.start(port=PORT)
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            try:
+                action = make_action("exposed", memory=128)
+                msgs = [make_msg(action, ident, True) for _ in range(8)]
+                await asyncio.gather(*[await bal.publish(action, m)
+                                       for m in msgs])
+                await asyncio.sleep(0.3)
+                bal.telemetry.device_fold()
+                bal.telemetry.tick(bal.metrics)  # slo_* gauges on the page
+                # a value that needs label escaping must not corrupt a line
+                bal.metrics.counter("exposition_escape_probe",
+                                    tags={"metric": 'a"b\\c\nd'})
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                            f"http://127.0.0.1:{PORT}/metrics") as r:
+                        return r.status, await r.text()
+            finally:
+                await controller.stop()
+                for inv in invokers:
+                    await inv.stop()
+
+        status, text = asyncio.run(go())
+        assert status == 200
+        out = validate_exposition(text)
+        types = out["types"]
+        # the whole catalog rides one page: counters, gauges, summaries,
+        # and the telemetry plane's REAL histogram families
+        assert types["openwhisk_loadbalancer_activations_published"] == "counter"
+        assert types["openwhisk_slo_burn_rate_1m"] == "gauge"
+        assert types["openwhisk_loadbalancer_tpu_readback_ms"] == "summary"
+        assert types[
+            "openwhisk_invoker_activation_latency_seconds"] == "histogram"
+        assert types[
+            "openwhisk_namespace_activation_latency_seconds"] == "histogram"
+        assert types[
+            "openwhisk_invoker_activation_outcomes_total"] == "counter"
+        # quantile lines present for summaries (satellite)
+        assert 'quantile="0.99"' in text
+        # at least one histogram series accumulated the 8 activations
+        fam_groups = [k for k in out["histograms"]
+                      if k[0] == "openwhisk_namespace_activation_latency_seconds"]
+        assert fam_groups, "no namespace latency series rendered"
