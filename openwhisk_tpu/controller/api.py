@@ -120,6 +120,10 @@ class ControllerApi:
         # SLO plane: compliance / budget / burn rates from the balancer's
         # telemetry accumulator, auth-gated like the placement endpoints
         r.add_get("/admin/slo", self.slo_report)
+        # kernel profiling plane: compile log / phase percentiles / HBM
+        # stats, plus the on-demand capture window (auth-gated)
+        r.add_get("/admin/profile/kernel", self.profile_kernel)
+        r.add_post("/admin/profile/capture", self.profile_capture)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -393,6 +397,56 @@ class ControllerApi:
         else:
             report = tp.slo_report(names)
         return web.json_response(report)
+
+    async def profile_kernel(self, request):
+        """The kernel profiling observatory: compile log + classification,
+        cache-key census, per-phase p50/p99 over the last N batches, HBM /
+        memory stats, and capture-window status — the same payload shape
+        from the TPU balancer and the CPU twins (`kernel: "cpu"`). Reads
+        are host-side only (no device array sync), so this runs inline."""
+        lb = self.c.load_balancer
+        if getattr(lb, "profiler", None) is None:
+            return _error(404, "this balancer has no kernel profiler",
+                          request.get("transid"))
+        if hasattr(lb, "kernel_profile"):
+            return web.json_response(lb.kernel_profile())
+        return web.json_response(lb.profiler.profile_json())
+
+    async def profile_capture(self, request):
+        """Arm a bounded capture window: `{"steps": N}` records the next N
+        dispatch steps at full detail (capped at the configured limit);
+        `"trace_dir"` additionally wraps a server-side `jax.profiler`
+        trace when the real profiler is importable; `"tail_threshold_ms"`
+        re-targets the tail sampler (0 disables it)."""
+        lb = self.c.load_balancer
+        prof = getattr(lb, "profiler", None)
+        if prof is None:
+            return _error(404, "this balancer has no kernel profiler",
+                          request.get("transid"))
+        if not prof.enabled:
+            return _error(409, "kernel profiling is disabled "
+                          "(CONFIG_whisk_profiling_enabled=false)",
+                          request.get("transid"))
+        body = (await request.json()) if request.can_read_body else {}
+        if not isinstance(body, dict):
+            return _error(400, "capture request body must be a JSON object",
+                          request.get("transid"))
+        try:
+            steps = int(body.get("steps", 16))
+            ttl = body.get("tail_threshold_ms")
+            ttl = float(ttl) if ttl is not None else None
+        except (TypeError, ValueError):
+            return _error(400, "steps must be an integer and "
+                          "tail_threshold_ms a number",
+                          request.get("transid"))
+        if steps < 1:
+            return _error(400, "steps must be >= 1", request.get("transid"))
+        trace_dir = body.get("trace_dir")
+        if trace_dir is not None and not isinstance(trace_dir, str):
+            return _error(400, "trace_dir must be a string",
+                          request.get("transid"))
+        return web.json_response(prof.arm_capture(
+            steps, trace_dir=trace_dir, tail_threshold_ms=ttl))
 
     async def placement_occupancy(self, request):
         """Per-invoker slots-in-use/capacity derived from the balancer

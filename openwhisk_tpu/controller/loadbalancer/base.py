@@ -29,6 +29,7 @@ from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
                                   parse_ack)
 from ...utils.logging import MetricEmitter
 from ...utils.transaction import TransactionId
+from ...ops.profiler import KernelProfiler
 from ...ops.telemetry import (OUTCOME_ERROR, OUTCOME_SUCCESS, OUTCOME_TIMEOUT)
 from .flight_recorder import BatchRecord, FlightRecorder
 from .telemetry import TelemetryPlane
@@ -146,7 +147,8 @@ class CommonLoadBalancer(LoadBalancer):
     def __init__(self, messaging_provider, controller_instance, logger=None,
                  metrics: Optional[MetricEmitter] = None,
                  flight_recorder: Optional[FlightRecorder] = None,
-                 telemetry: Optional[TelemetryPlane] = None):
+                 telemetry: Optional[TelemetryPlane] = None,
+                 profiler: Optional[KernelProfiler] = None):
         self.provider = messaging_provider
         self.controller = controller_instance
         self.logger = logger
@@ -170,6 +172,16 @@ class CommonLoadBalancer(LoadBalancer):
                           else TelemetryPlane.from_config())
         self._telemetry_renderer = self._telemetry_exposition
         self.metrics.register_renderer(self._telemetry_renderer)
+        # the kernel profiling plane (same hook pattern): compile tracking,
+        # per-phase device timing, HBM watermarks and the capture window —
+        # device entry points for the TPU balancer, a `kernel: "cpu"`
+        # profile for the NumPy twins, one `/admin/profile/*` surface
+        self.profiler = (profiler if profiler is not None
+                         else KernelProfiler.from_config())
+        self.profiler.logger = logger
+        self.profiler.metrics = self.metrics
+        self._profiler_renderer = self.profiler.prometheus_text
+        self.metrics.register_renderer(self._profiler_renderer)
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -422,6 +434,14 @@ class CommonLoadBalancer(LoadBalancer):
     def _telemetry_exposition(self) -> str:
         return self.telemetry.prometheus_text(self._telemetry_invoker_names())
 
+    # -- kernel profiling plane (shared hook, like the flight recorder) ----
+    def kernel_profile(self) -> dict:
+        """The `GET /admin/profile/kernel` payload. CPU balancers report a
+        `kernel: "cpu"` profile (schedule-phase timings, empty compile
+        log); the TPU balancer overrides the kernel label with what it
+        actually resolved."""
+        return self.profiler.profile_json(kernel="cpu")
+
     # -- subclass hooks ----------------------------------------------------
     def release_invoker(self, invoker: InvokerInstanceId, entry: ActivationEntry) -> None:
         """Return the capacity slot taken for this activation."""
@@ -438,5 +458,6 @@ class CommonLoadBalancer(LoadBalancer):
                 entry.timeout_task.cancel()
         self.activation_slots.clear()
         # shared (process-wide) emitters outlive the balancer: stop
-        # contributing telemetry families once closed
+        # contributing telemetry/profiling families once closed
         self.metrics.unregister_renderer(self._telemetry_renderer)
+        self.metrics.unregister_renderer(self._profiler_renderer)

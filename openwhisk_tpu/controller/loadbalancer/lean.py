@@ -11,6 +11,7 @@ common activation-slot map).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import List, Optional
 
 from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
@@ -39,7 +40,24 @@ class LeanBalancer(CommonLoadBalancer):
         self.record_placement(msg, action, 0, self.invoker_id,
                               digest={"healthy_invokers": 1})
         promise = self.setup_activation(msg, action, self.invoker_id)
+        t0 = time.monotonic()
         await self.send_activation_to_invoker(msg, self.invoker_id)
+        dispatch_ms = (time.monotonic() - t0) * 1e3
+        # lean mode's only data-plane hop: the in-process bus send, reported
+        # as a dispatch phase so /admin/profile/kernel answers here too
+        prof = self.profiler
+        prof.observe_phase("dispatch", dispatch_ms)
+        if prof.capture_armed:
+            # each publish is one dispatch step here, so the capture
+            # window drains (and stops any live trace) on lean too
+            prof.capture_step({
+                "ts": time.time(), "kernel": "cpu",
+                "action": str(action.fully_qualified_name),
+                "invoker": self.invoker_id.as_string,
+                "total_ms": round(dispatch_ms, 3)})
+        # no supervision scheduler to ride: HBM gauges refresh off the
+        # dispatch stream instead (1 Hz-capped, like telemetry maybe_tick)
+        prof.maybe_refresh_memory(self.metrics)
         return promise
 
     async def invoker_health(self) -> List[InvokerHealth]:

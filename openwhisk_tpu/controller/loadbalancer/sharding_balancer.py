@@ -9,6 +9,7 @@ alternative to the TPU balancer behind the same LoadBalancerProvider SPI.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import List, Optional
 
 from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
@@ -33,9 +34,15 @@ class ShardingBalancer(CommonLoadBalancer):
         self.supervision = InvokerPool(
             messaging_provider, on_status_change=self._status_change,
             logger=logger, group=f"health-{controller_instance.as_string}",
-            on_tick=lambda: self.telemetry.tick(self.metrics))
+            on_tick=self._plane_tick)
         self._registry: List[InvokerInstanceId] = []
         self._usable: List[bool] = []
+
+    def _plane_tick(self) -> None:
+        self.telemetry.tick(self.metrics)
+        # guarded no-op on CPU backends — present so the profiling plane
+        # behaves identically should this balancer run beside a device
+        self.profiler.refresh_memory(self.metrics)
 
     async def start(self) -> None:
         self.start_ack_feed()
@@ -64,12 +71,26 @@ class ShardingBalancer(CommonLoadBalancer):
     async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
                       ) -> asyncio.Future:
         meta = action.exec_metadata()
+        t0 = time.monotonic()
         chosen, forced = schedule(
             self.policy, str(msg.user.namespace.name),
             str(action.fully_qualified_name),
             action.limits.memory.megabytes,
             action.limits.concurrency.max_concurrent,
             blackbox=meta.is_blackbox)
+        schedule_ms = (time.monotonic() - t0) * 1e3
+        # the CPU twin's "device step": the probe walk itself, reported as
+        # a schedule phase so /admin/profile/kernel answers p50/p99 here too
+        self.profiler.observe_phase("schedule", schedule_ms)
+        if self.profiler.capture_armed:
+            # each publish is one "dispatch step" for the CPU twin, so an
+            # armed capture window drains (and stops any live trace) here
+            self.profiler.capture_step({
+                "ts": time.time(), "kernel": "cpu",
+                "action": str(action.fully_qualified_name),
+                "invoker_index": None if chosen is None else int(chosen),
+                "forced": bool(forced),
+                "total_ms": round(schedule_ms, 3)})
         if chosen is None:
             raise LoadBalancerException(
                 "No invokers available to schedule the activation.")

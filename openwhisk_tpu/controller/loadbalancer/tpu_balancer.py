@@ -182,8 +182,10 @@ class TpuBalancer(CommonLoadBalancer):
                  action_slots: int = 4096, max_action_slots: int = 65536,
                  initial_pad: int = 64, mesh=None, kernel: str = "auto",
                  pipeline_depth: int = 4,
-                 rate_limit_per_minute: Optional[int] = None):
-        super().__init__(messaging_provider, controller_instance, logger, metrics)
+                 rate_limit_per_minute: Optional[int] = None,
+                 profiler=None):
+        super().__init__(messaging_provider, controller_instance, logger,
+                         metrics, profiler=profiler)
         self._cluster_size = cluster_size
         self.kernel = kernel  # "auto" | "xla" | "pallas" (single-device)
         self.managed_fraction = managed_fraction
@@ -257,6 +259,9 @@ class TpuBalancer(CommonLoadBalancer):
         # still converge their device counts)
         self.telemetry.device_fold()
         self.telemetry.tick(self.metrics)
+        # HBM watermark gauges ride the same 1 Hz tick (guarded no-op on
+        # backends without memory_stats, e.g. CPU)
+        self.profiler.refresh_memory(self.metrics)
 
     # -- device state ------------------------------------------------------
     def _resolve_kernel(self) -> str:
@@ -315,9 +320,17 @@ class TpuBalancer(CommonLoadBalancer):
         self._build_packed_fns()
 
     def _build_packed_fns(self) -> None:
+        # the profiler interposes on every jitted entry point: compile
+        # events classify by first-call / expect-window / pow2-bucketed
+        # statics (the only shapes _bucket may produce) — anything else is
+        # shape churn and trips the recompile watchdog
+        from ...ops.profiler import pow2_statics
         if self.rate_limit_per_minute is not None:
-            self._packed_fn = make_fused_admit_step_packed(self._release_fn,
-                                                           self._sched_fn)
+            self._packed_fn = self.profiler.wrap(
+                "fused_admit_step",
+                make_fused_admit_step_packed(self._release_fn,
+                                             self._sched_fn),
+                expected=pow2_statics)
             # bucket state is SOFT (a rolling rate window, never
             # checkpointed) but it CARRIES across kernel swaps and growth
             # rebuilds — re-initializing here would grant every namespace a
@@ -326,9 +339,13 @@ class TpuBalancer(CommonLoadBalancer):
                 self._bucket_state = init_buckets(self.RATE_NS_BUCKETS,
                                                   self.rate_limit_per_minute)
         else:
-            self._packed_fn = make_fused_step_packed(self._release_fn,
-                                                     self._sched_fn)
-        self._release_packed_fn = make_release_packed(self._release_fn)
+            self._packed_fn = self.profiler.wrap(
+                "fused_step",
+                make_fused_step_packed(self._release_fn, self._sched_fn),
+                expected=pow2_statics)
+        self._release_packed_fn = self.profiler.wrap(
+            "release_packed", make_release_packed(self._release_fn),
+            expected=lambda st, rel: _next_pow2(rel.shape[1]) == rel.shape[1])
 
     def _ns_slot(self, ns_id: str) -> int:
         slot = self._ns_slots.get(ns_id)
@@ -350,6 +367,7 @@ class TpuBalancer(CommonLoadBalancer):
     def _use_xla_kernels(self) -> None:
         """Swap the XLA schedule/release kernels in (pallas state outgrew
         the VMEM budget, via growth or snapshot restore)."""
+        self.profiler.expect("kernel_swap")
         self.kernel_resolved = "xla"
         self._sched_fn = schedule_batch
         self._release_fn = release_batch
@@ -400,6 +418,7 @@ class TpuBalancer(CommonLoadBalancer):
         old_free = np.asarray(self.state.free_mb)
         old_conc = np.asarray(self.state.conc_free)
         old_health = np.asarray(self.state.health)
+        self.profiler.expect("fleet_growth")
         n_old = old_free.shape[0]
         free = np.zeros((new_pad,), np.int32)
         free[:n_old] = old_free
@@ -444,6 +463,7 @@ class TpuBalancer(CommonLoadBalancer):
 
     def _grow_slots(self, new_slots: int) -> None:
         """Widen conc_free's action axis, preserving every live permit."""
+        self.profiler.expect("slot_growth")
         old_conc = np.asarray(self.state.conc_free)
         conc = np.zeros((old_conc.shape[0], new_slots), np.int32)
         conc[:, : old_conc.shape[1]] = old_conc
@@ -475,6 +495,7 @@ class TpuBalancer(CommonLoadBalancer):
         (ref updateCluster :561-584)."""
         if cluster_size != self._cluster_size:
             self._cluster_size = cluster_size
+            self.profiler.expect("cluster_resize")
             self._init_device_state()
             self._recompute_partitions()  # capacity shares changed
 
@@ -641,6 +662,12 @@ class TpuBalancer(CommonLoadBalancer):
 
         return occupancy_json(self.kernel_resolved, rows())
 
+    def kernel_profile(self) -> dict:
+        """The profiling-plane payload, labeled with the kernel actually
+        running (xla / pallas / sharded) — host-side reads only, no device
+        sync (memory_stats is a runtime counter read, not an array pull)."""
+        return self.profiler.profile_json(kernel=self.kernel_resolved)
+
     # -- checkpoint / resume (SURVEY §5.4) ---------------------------------
     def snapshot_parts(self) -> dict:
         """Event-loop-side capture for a snapshot: ONE consistent reference
@@ -677,6 +704,7 @@ class TpuBalancer(CommonLoadBalancer):
         return parts
 
     def restore(self, snap: dict) -> None:
+        self.profiler.expect("snapshot_restore")
         self._n_pad = int(snap["n_pad"])
         self._cluster_size = int(snap["cluster_size"])
         # older snapshots predate the growable slot axis
@@ -925,6 +953,9 @@ class TpuBalancer(CommonLoadBalancer):
         self.metrics.histogram("loadbalancer_tpu_dispatch_ms",
                                (t_dispatched - t_assembled) * 1e3)
         self.metrics.histogram("loadbalancer_tpu_batch_size", b)
+        self.profiler.observe_phase("assembly", (t_assembled - t0) * 1e3)
+        self.profiler.observe_phase("dispatch",
+                                    (t_dispatched - t_assembled) * 1e3)
         if rec is not None:
             rec.timings["assembly_ms"] = round((t_assembled - t0) * 1e3, 3)
             rec.timings["dispatch_ms"] = round(
@@ -956,6 +987,7 @@ class TpuBalancer(CommonLoadBalancer):
             t_r1 = time.monotonic()
             rb_ms = (t_r1 - t_r0) * 1e3
             self.metrics.histogram("loadbalancer_tpu_readback_ms", rb_ms)
+            self.profiler.observe_phase("readback", rb_ms)
             # benign cross-thread write: a float EWMA steering a heuristic
             self._rtt_ewma_ms = 0.8 * self._rtt_ewma_ms + 0.2 * rb_ms
             # the EWMA silently flips the eager-vs-batched dispatch policy
@@ -1035,28 +1067,44 @@ class TpuBalancer(CommonLoadBalancer):
             elif not fut.done():
                 fut.set_result((-2 if thr else int(inv_idx), bool(f)))
         t_f1 = time.monotonic()
-        self.metrics.histogram("loadbalancer_tpu_fanout_ms",
-                               (t_f1 - t_f0) * 1e3)
+        fanout_ms = (t_f1 - t_f0) * 1e3
+        self.metrics.histogram("loadbalancer_tpu_fanout_ms", fanout_ms)
+        prof = self.profiler
+        prof.observe_phase("fanout", fanout_ms)
+        prof.observe_phase("total", dt_ms)
         if rec is not None:
+            # tail sampling: with a threshold armed, full per-decision rows
+            # are filed only for slow batches (a live capture window takes
+            # everything); skipped batches still refresh the gauges
             self._record_batch(rec, batch, chosen_np, forced_np, throttled_np,
-                               (t_f1 - t_f0) * 1e3)
+                               fanout_ms, file=prof.admit_batch(dt_ms))
+            if prof.capture_armed:
+                row = rec.to_json()
+                row["total_ms"] = round(dt_ms, 3)
+                prof.capture_step(row)
+        elif prof.capture_armed:
+            # flight recorder off: the capture window still gets timings
+            prof.capture_step({"ts": time.time(), "batch_size": b,
+                               "total_ms": round(dt_ms, 3)})
 
     def _record_batch(self, rec, batch, chosen_np, forced_np, throttled_np,
-                      fanout_ms: float) -> None:
+                      fanout_ms: float, file: bool = True) -> None:
         """Finish and file the flight-recorder record for one micro-batch,
-        and refresh the introspection gauges."""
-        n_reg = len(self._registry)
-        decisions = rec.decisions
-        for (req, fut, slot_key, t_enq, aid, act), ci, f, thr in zip(
-                batch, chosen_np, forced_np, throttled_np):
-            ci = int(ci)
-            name = (self._registry[ci].as_string
-                    if 0 <= ci < n_reg else None)
-            decisions.append((aid, act, ci, name, bool(f), bool(thr),
-                              req[self.R_NEED_MB]))
+        and refresh the introspection gauges. `file=False` (tail-sampled
+        fast batch) refreshes the gauges without ringing the record."""
         rec.timings["fanout_ms"] = round(fanout_ms, 3)
         fr = self.flight_recorder
-        fr.record(rec)
+        if file:
+            n_reg = len(self._registry)
+            decisions = rec.decisions
+            for (req, fut, slot_key, t_enq, aid, act), ci, f, thr in zip(
+                    batch, chosen_np, forced_np, throttled_np):
+                ci = int(ci)
+                name = (self._registry[ci].as_string
+                        if 0 <= ci < n_reg else None)
+                decisions.append((aid, act, ci, name, bool(f), bool(thr),
+                                  req[self.R_NEED_MB]))
+            fr.record(rec)
         m = self.metrics
         d = rec.digest
         m.gauge("loadbalancer_placement_queue_depth", d["queue_depth"])

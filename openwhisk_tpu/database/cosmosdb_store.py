@@ -304,17 +304,20 @@ class CosmosDbArtifactStore(ArtifactStore):
         ns_root = namespace.split("/")[0] if namespace is not None else None
         packaged = namespace is not None and "/" in namespace
         where, params = self._where(collection, ns_root, since, upto)
-        order = "DESC" if descending else "ASC"
-        sql = f"SELECT * FROM c WHERE {where} ORDER BY c._sort {order}"
+        # cross-partition ORDER BY needs query-plan + per-partition-key-
+        # range execution (the SDK's job); the raw-REST gateway rejects it
+        # outright — omit it and merge-sort client-side instead
+        order = (f" ORDER BY c._sort {'DESC' if descending else 'ASC'}"
+                 if ns_root is not None else "")
+        sql = f"SELECT * FROM c WHERE {where}{order}"
         pushdown = name is None and not packaged and namespace is not None
         if pushdown and (skip or limit):
             sql += f" OFFSET {int(skip)} LIMIT {int(limit) or 2147483647}"
         rows = await self._sql(sql, params, ns_root)
         docs = [self._restore(r) for r in rows]
         if ns_root is None:
-            # cross-partition ORDER BY over raw REST returns per-partition
-            # sorted streams, not a global merge (the SDK's job) — sort
-            # client-side on the same key the SQL ordered by
+            # the gateway served unmerged per-partition-key-range streams:
+            # sort client-side on the key single-partition SQL orders by
             docs.sort(key=lambda d: d.get("start") or d.get("updated") or 0,
                       reverse=descending)
         if packaged:
@@ -338,20 +341,41 @@ class CosmosDbArtifactStore(ArtifactStore):
                                         since, upto))
         ns_root = namespace.split("/")[0] if namespace is not None else None
         where, params = self._where(collection, ns_root, since, upto)
+        if ns_root is None:
+            # cross-partition aggregates need per-partition-key-range
+            # execution the raw-REST gateway won't do for us — count by
+            # paging ids (continuation already drains every range)
+            rows = await self._sql(
+                f"SELECT c.id FROM c WHERE {where}", params, None)
+            return len(rows)
         rows = await self._sql(
             f"SELECT VALUE COUNT(1) FROM c WHERE {where}", params, ns_root)
-        # cross-partition aggregates arrive as one partial COUNT per
-        # partition key range over raw REST (merging them is the SDK's
-        # job): sum, don't take the first
+        # a single-partition COUNT can still arrive as one partial per
+        # served page: sum, don't take the first
         return int(sum(rows))
 
     # -- attachments (sidecar documents; see module docstring) -------------
+    #: characters an attachment name must exclude: '/' would add a path
+    #: segment to the sidecar id (read_attachment and delete_attachments'
+    #: endswith("/" + name) would mismatch), '|' round-trips asymmetrically
+    #: through the id encoding ('|' -> '/' on read), and '\\', '?', '#'
+    #: are forbidden in Cosmos document ids outright
+    _FORBIDDEN_NAME_CHARS = frozenset("/|\\?#")
+
     @staticmethod
     def _att_doc_id(doc_id: str, name: Optional[str] = None) -> str:
         return f"att:{doc_id}" + (f"/{name}" if name else "")
 
+    @classmethod
+    def _check_attachment_name(cls, name: str) -> None:
+        if not name or any(c in cls._FORBIDDEN_NAME_CHARS for c in name):
+            raise ArtifactStoreException(
+                f"invalid attachment name {name!r}: must be non-empty and "
+                "exclude / | \\ ? # (sidecar doc ids embed the name)")
+
     async def attach(self, doc_id: str, name: str, content_type: str,
                      data: bytes) -> None:
+        self._check_attachment_name(name)
         if self.attachment_store is not None:
             return await self.attachment_store.attach(doc_id, name,
                                                       content_type, data)

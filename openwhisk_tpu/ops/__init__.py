@@ -7,6 +7,7 @@ per-activation CPU probe loop with a vectorized bin-packing step).
 """
 from .placement import (PlacementState, RequestBatch, init_state,
                         schedule_batch, release_batch, set_health)
+from .profiler import KernelProfiler, ProfilingConfig
 from .throttle import TokenBucketState, admit_batch, init_buckets
 
 __all__ = [n for n in dir() if not n.startswith("_")]

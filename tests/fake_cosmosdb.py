@@ -34,9 +34,15 @@ fake encodes, reviewable per endpoint:
     the partition-key header; cross-partition ones must send
     `x-ms-documentdb-query-enablecrosspartition: true` (enforced here:
     a cross-partition query without the header is 400, the documented
-    behavior). Results page via the `x-ms-continuation` header (this
-    fake pages every PAGE_SIZE docs to force the client's continuation
-    loop to execute).
+    behavior). Cross-partition results arrive as one unmerged stream
+    per partition key range (grouped by partition key, NOT globally
+    sorted), cross-partition ORDER BY is rejected with 400 (it needs
+    query-plan + per-range execution, which raw REST does not do), and
+    a cross-partition `SELECT VALUE COUNT(1)` answers one PARTIAL count
+    per partition key range — so the client's merge/sort/sum code is
+    genuinely exercised. Results page via the `x-ms-continuation`
+    header (this fake pages every PAGE_SIZE docs to force the client's
+    continuation loop to execute).
   - **SQL dialect**: the fake evaluates the exact parameterized query
     family the store emits — equality/range predicates over scalar
     fields, STARTSWITH, ORDER BY one field ASC|DESC, OFFSET/LIMIT, and
@@ -216,7 +222,18 @@ class FakeCosmosDB:
                 {"code": "BadRequest",
                  "message": "cross partition query is required"},
                 status=400)
-        docs = [d for (p, _), d in store.items() if pk is None or p == pk]
+        if pk is None:
+            # cross-partition: the gateway serves one stream PER partition
+            # key range with no global merge — group by partition key (in
+            # key order, which is NOT the documents' sort order) so the
+            # client's merge/sort code is genuinely exercised
+            parts = {}
+            for (p, _), d in store.items():
+                parts.setdefault(p, []).append(d)
+            docs = [d for p in sorted(parts) for d in parts[p]]
+        else:
+            parts = None
+            docs = [d for (key_pk, _), d in store.items() if key_pk == pk]
         params = {p["name"]: p["value"] for p in body.get("parameters", [])}
         sql = body["query"]
 
@@ -246,6 +263,15 @@ class FakeCosmosDB:
                 return str(doc.get(field, "")).startswith(params[p])
             raise AssertionError(f"unsupported clause {clause!r}")
 
+        if pk is None and m.group("ofield"):
+            # the real gateway rejects cross-partition ORDER BY over raw
+            # REST (it needs query-plan + per-range execution, the SDK's
+            # job) — enforcing it here keeps the store honest
+            return web.json_response(
+                {"code": "BadRequest",
+                 "message": "cross partition ORDER BY requires a query "
+                            "plan (not supported over raw REST)"},
+                status=400)
         if m.group("where"):
             for clause in m.group("where").split(" AND "):
                 docs = [d for d in docs if pred(d, clause.strip())]
@@ -256,6 +282,14 @@ class FakeCosmosDB:
             docs = docs[int(m.group("off")):]
             docs = docs[: int(m.group("lim"))]
         if m.group("sel") == "VALUE COUNT(1)":
+            if pk is None:
+                # cross-partition aggregate: one PARTIAL count per
+                # partition key range, never a merged total (summing the
+                # partials is the client's job)
+                partials = [sum(1 for d in docs if d.get("_nsroot") == p)
+                            for p in sorted(parts)]
+                return web.json_response({"Documents": partials,
+                                          "_count": len(partials)})
             return web.json_response({"Documents": [len(docs)],
                                       "_count": 1})
         if m.group("sel") not in ("*",):

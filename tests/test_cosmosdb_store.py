@@ -10,7 +10,8 @@ from urllib.parse import quote
 
 import pytest
 
-from openwhisk_tpu.database import DocumentConflict, NoDocumentException
+from openwhisk_tpu.database import (ArtifactStoreException, DocumentConflict,
+                                    NoDocumentException)
 from openwhisk_tpu.database.cosmosdb_store import (CosmosDbArtifactStore,
                                                    CosmosDbArtifactStoreProvider)
 from tests.fake_cosmosdb import MASTER_KEY, FakeCosmosDB
@@ -178,6 +179,81 @@ class TestCosmosReviewRegressions:
                 == b"x"
             assert len(await store.query("actions", "att")) == 1
             await store.delete("att/myaction", rev)
+            await store.close()
+            await fake.stop()
+        run(go())
+
+    def test_cross_partition_query_merges_per_range_streams(self):
+        """ISSUE 3 satellite: cross-partition SQL carries no ORDER BY (the
+        raw-REST gateway rejects it), so the fake serves one unmerged
+        stream per partition key range — interleave sort keys across three
+        partitions and the client-side merge sort must still produce one
+        globally ordered list, both directions."""
+        async def go():
+            fake = FakeCosmosDB()  # PAGE_SIZE=3: continuations too
+            url = await fake.start()
+            store = CosmosDbArtifactStore(url, key=MASTER_KEY)
+            # updated values interleave ACROSS partitions, so partition-key
+            # order (nsa, nsb, nsc) is NOT the sort order
+            for i, ns in enumerate(("nsa", "nsb", "nsc") * 3):
+                await store.put(f"{ns}/a{i}", {"entityType": "actions",
+                                               "namespace": ns,
+                                               "name": f"a{i}",
+                                               "updated": i + 1})
+            docs = await store.query("actions", None)
+            assert len(docs) == 9
+            assert [d["updated"] for d in docs] == list(range(9, 0, -1))
+            asc = await store.query("actions", None, descending=False)
+            assert [d["updated"] for d in asc] == list(range(1, 10))
+            await store.close()
+            await fake.stop()
+        run(go())
+
+    def test_cross_partition_count_pages_ids_across_ranges(self):
+        """ISSUE 3 satellite: the fake answers a cross-partition
+        `SELECT VALUE COUNT(1)` with one PARTIAL count per partition key
+        range, so the store counts by paging ids instead — the total must
+        cover every partition through the continuation loop."""
+        async def go():
+            fake = FakeCosmosDB()  # PAGE_SIZE=3 forces continuations
+            url = await fake.start()
+            store = CosmosDbArtifactStore(url, key=MASTER_KEY)
+            for i, ns in enumerate(("nsa", "nsb") * 4):
+                await store.put(f"{ns}/a{i}", {"entityType": "actions",
+                                               "namespace": ns,
+                                               "name": f"a{i}",
+                                               "updated": i + 1})
+            assert await store.count("actions", None) == 8
+            assert await store.count("actions", "nsa") == 4
+            await store.close()
+            await fake.stop()
+        run(go())
+
+    def test_attachment_names_reject_id_breaking_chars(self):
+        """ISSUE 3 satellite: sidecar doc ids embed the attachment name, so
+        '/' (adds a path segment), '|' (the id encoding maps it to '/' on
+        read — the encode/decode asymmetry), and the Cosmos-forbidden
+        '\\', '?', '#' must be rejected at attach() before a sidecar is
+        written with an id that cannot round-trip."""
+        async def go():
+            fake = FakeCosmosDB()
+            url = await fake.start()
+            store = CosmosDbArtifactStore(url, key=MASTER_KEY)
+            await store.put("ns/a", {"entityType": "actions",
+                                     "namespace": "ns", "name": "a",
+                                     "updated": 1})
+            for bad in ("co|de", "co/de", "co\\de", "co?de", "co#de", ""):
+                with pytest.raises(ArtifactStoreException):
+                    await store.attach("ns/a", bad, "text/plain", b"x")
+            # nothing leaked into the collection as a sidecar
+            coll = fake.dbs["whisks"]["whisks"]
+            assert not any(i.startswith("att:") for (_, i) in coll)
+            # the '|' asymmetry regression: had 'co|de' been written, its
+            # sidecar id would decode with '/' where the '|' was, so the
+            # name could never be read back under the name it was attached
+            # with — a dotted name (legal) still round-trips exactly
+            await store.attach("ns/a", "co.de-1", "text/plain", b"ok")
+            assert (await store.read_attachment("ns/a", "co.de-1"))[1] == b"ok"
             await store.close()
             await fake.stop()
         run(go())

@@ -1,0 +1,532 @@
+"""Kernel profiling observatory (ISSUE 3).
+
+Covers: compile-event detection + classification (first call, expect
+window, bucketed-shape predicate, shape churn) over plain and real-jit
+entry points; the recompile watchdog (structured warning + tagged
+counter); the capture window arm/drain cycle (with the real
+`jax.profiler` trace marked slow); tail-sampling admission; the guarded
+memory-stats read; the disabled-profiler true-no-op contract asserted
+with tracemalloc; the balancer integrations (induced shape churn on the
+TPU balancer classifying expected=false, CPU twins answering the same
+profile shape); and the two /admin/profile/* controller endpoints.
+"""
+import asyncio
+import base64
+import tracemalloc
+
+import aiohttp
+import numpy as np
+import pytest
+
+from openwhisk_tpu.controller.loadbalancer import (LeanBalancer,
+                                                   ShardingBalancer,
+                                                   TpuBalancer)
+from openwhisk_tpu.core.entity import (ControllerInstanceId, Identity,
+                                       WhiskAuthRecord)
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+from openwhisk_tpu.ops.profiler import (KernelProfiler, ProfilingConfig,
+                                        pow2_statics)
+from openwhisk_tpu.utils.logging import MetricEmitter
+from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+
+class _WarnCatcher:
+    def __init__(self):
+        self.warns = []
+
+    def warn(self, transid, msg, component=""):
+        self.warns.append(msg)
+
+    def info(self, *a, **k):
+        pass
+
+    error = info
+
+
+def _prof(**cfg) -> KernelProfiler:
+    p = KernelProfiler(ProfilingConfig(**cfg))
+    p.metrics = MetricEmitter()
+    p.logger = _WarnCatcher()
+    return p
+
+
+class TestCompileClassification:
+    def test_first_call_then_cache_hits(self):
+        p = _prof()
+        calls = []
+        f = p.wrap("entry", lambda x: calls.append(x) or len(calls))
+        a = np.zeros((8,), np.int32)
+        assert f(a) == 1 and f(a) == 2 and f(np.ones((8,), np.int32)) == 3
+        log = p.compile_log()
+        assert len(log) == 1  # same (shape, dtype) key: one compile
+        assert log[0]["expected"] is True and log[0]["reason"] == "first_call"
+        census = p.cache_census()["entry"]
+        assert census == {"signatures": 1, "compiles": 1, "calls": 3}
+
+    def test_expect_window_classifies_growth(self):
+        p = _prof()
+        f = p.wrap("entry", lambda x: x)
+        f(np.zeros((8,), np.int32))
+        p.expect("fleet_growth")
+        f(np.zeros((16,), np.int32))  # new shape inside the window
+        log = p.compile_log()
+        assert [e["reason"] for e in log] == ["first_call", "fleet_growth"]
+        assert all(e["expected"] for e in log)
+        assert p.compiles_expected == 2 and p.compiles_unexpected == 0
+
+    def test_bucketed_shape_predicate_vs_churn(self):
+        p = _prof(expect_window_s=0.0)  # no grace window
+        f = p.wrap("entry", lambda x, b: x, expected=pow2_statics)
+        f(np.zeros((8,), np.int32), 8)    # first_call
+        f(np.zeros((8,), np.int32), 16)   # pow2 static: bucketed_shape
+        f(np.zeros((8,), np.int32), 13)   # non-pow2 static: churn
+        log = p.compile_log()
+        assert [e["reason"] for e in log] == \
+            ["first_call", "bucketed_shape", "shape_churn"]
+        assert p.compiles_unexpected == 1
+        # the watchdog: structured warning + tagged counter
+        assert any("shape churn" in w for w in p.logger.warns)
+        assert p.metrics.counter_value("loadbalancer_kernel_recompiles_total",
+                                       tags={"expected": "false"}) == 1
+        assert p.metrics.counter_value("loadbalancer_kernel_recompiles_total",
+                                       tags={"expected": "true"}) == 2
+
+    def test_rewrap_with_new_fn_resets_signature_cache(self):
+        p = _prof()
+        f1 = p.wrap("entry", lambda x: 1)
+        f1(np.zeros((8,), np.int32))
+        p.expect("kernel_swap")
+        f2 = p.wrap("entry", lambda x: 2)  # rebuilt entry point
+        assert f2(np.zeros((8,), np.int32)) == 2
+        log = p.compile_log()
+        assert [e["reason"] for e in log] == ["first_call", "kernel_swap"]
+
+    def test_real_jit_compiles_are_detected(self):
+        import jax
+        import jax.numpy as jnp
+        p = _prof()
+        f = p.wrap("jit", jax.jit(lambda x: jnp.sum(x * 2)))
+        out = f(np.arange(8, dtype=np.int32))
+        assert int(out) == 56
+        f(np.arange(8, dtype=np.int32))       # cache hit
+        f(np.arange(16, dtype=np.int32))      # second shape: new compile
+        log = p.compile_log()
+        assert len(log) == 2
+        assert log[0]["wall_ms"] > log[1].get("_never", 0)  # wall recorded
+        assert p.cache_census()["jit"]["signatures"] == 2
+
+
+class TestPhasesAndMemory:
+    def test_phase_rollups_and_exposition(self):
+        p = _prof(phase_window=64)
+        for ms in (1.0, 2.0, 3.0, 100.0):
+            p.observe_phase("readback", ms)
+        roll = p.phase_rollups()["readback"]
+        assert roll["count"] == 4
+        assert roll["p50_ms"] in (2.0, 3.0)
+        assert roll["p99_ms"] == 100.0
+        text = p.prometheus_text()
+        assert ("# TYPE openwhisk_loadbalancer_phase_duration_seconds "
+                "histogram") in text
+        assert 'phase="readback"' in text and 'le="+Inf"' in text
+        # cumulative +Inf bucket equals _count
+        inf_line = [l for l in text.splitlines() if 'le="+Inf"' in l][0]
+        cnt_line = [l for l in text.splitlines() if "_count" in l][0]
+        assert inf_line.rsplit(" ", 1)[1] == cnt_line.rsplit(" ", 1)[1] == "4"
+
+    def test_memory_stats_guard_on_cpu(self):
+        # CPU backend: memory_stats is absent/None — a guarded no-op dict
+        p = _prof()
+        stats = p.memory_stats()
+        assert isinstance(stats, dict)
+        m = MetricEmitter()
+        out = p.refresh_memory(m)  # must never raise, whatever the backend
+        assert isinstance(out, dict)
+
+    def test_refresh_memory_gauges_and_watermark(self, monkeypatch):
+        p = _prof()
+        m = MetricEmitter()
+        monkeypatch.setattr(p, "memory_stats", lambda: {
+            "bytes_in_use": 1000, "peak_bytes_in_use": 1500,
+            "bytes_limit": 4000})
+        p.refresh_memory(m)
+        monkeypatch.setattr(p, "memory_stats", lambda: {
+            "bytes_in_use": 500, "bytes_limit": 4000})
+        p.refresh_memory(m)
+        assert m.gauge_value("loadbalancer_hbm_bytes_in_use") == 500
+        # the high watermark survives a backend that stops reporting peak
+        assert m.gauge_value("loadbalancer_hbm_peak_bytes_in_use") == 1500
+        assert m.gauge_value("loadbalancer_hbm_bytes_limit") == 4000
+        assert m.gauge_value("loadbalancer_hbm_utilization_ratio") == 0.125
+
+
+class TestCaptureAndTailSampling:
+    def test_capture_window_arm_and_drain(self):
+        p = _prof(capture_limit=8)
+        assert p.capture_step({"x": 1}) is False  # not armed
+        status = p.arm_capture(3)
+        assert status["armed"] and status["steps"] == 3
+        assert p.capture_armed
+        for i in range(3):
+            assert p.capture_step({"step": i}) is True
+        assert p.capture_step({"step": 99}) is False  # drained
+        assert not p.capture_armed
+        cap = p.profile_json("xla")["capture"]
+        assert cap["captured"] == 3 and cap["remaining"] == 0
+        assert [r["step"] for r in cap["steps"]] == [0, 1, 2]
+
+    def test_capture_steps_capped_at_limit(self):
+        p = _prof(capture_limit=4)
+        assert p.arm_capture(10_000)["steps"] == 4
+
+    def test_tail_sampling_admission(self):
+        p = _prof(tail_threshold_ms=50.0)
+        assert p.admit_batch(10.0) is False   # fast batch: row dropped
+        assert p.admit_batch(60.0) is True    # slow batch: kept
+        assert p.tail_skipped == 1
+        p.arm_capture(2)
+        assert p.admit_batch(10.0) is True    # capture wants everything
+        p2 = _prof()  # threshold 0: everything kept
+        assert p2.admit_batch(0.001) is True and p2.tail_skipped == 0
+
+    def test_rearm_retargets_tail_threshold(self):
+        p = _prof()
+        p.arm_capture(1, tail_threshold_ms=25.0)
+        assert p.tail_threshold_ms == 25.0
+        p.capture_step({})
+        assert p.admit_batch(10.0) is False
+
+    @pytest.mark.slow
+    def test_real_jax_profiler_trace(self, tmp_path):
+        # the real jax.profiler wrap: arm with a trace_dir, drain, and the
+        # trace directory must exist (contents are backend-dependent)
+        import jax
+        import jax.numpy as jnp
+        p = _prof()
+        status = p.arm_capture(1, trace_dir=str(tmp_path / "trace"))
+        if not status["trace"].get("active"):
+            pytest.skip(f"jax.profiler unavailable: {status['trace']}")
+        jnp.sum(jnp.arange(16)).block_until_ready()
+        p.capture_step({"step": 0})  # drains the window -> stops the trace
+        assert p._trace_active is False
+        assert (tmp_path / "trace").exists()
+
+
+class TestDisabledNoOp:
+    def test_wrap_is_identity_and_hot_paths_allocate_nothing(self):
+        p = KernelProfiler(ProfilingConfig(enabled=False))
+
+        def fn(x):
+            return x
+
+        assert p.wrap("entry", fn) is fn  # no wrapper frame at all
+        assert p.admit_batch(1.0) is True
+        # warm the paths once, then assert zero residual allocations
+        p.observe_phase("assembly", 1.0)
+        p.capture_step({})
+        p.expect("x")
+        tracemalloc.start()
+        try:
+            s1 = tracemalloc.take_snapshot()
+            for _ in range(256):
+                p.observe_phase("assembly", 1.0)
+                p.admit_batch(1.0)
+                p.capture_step({})
+                p.expect("x")
+            s2 = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        flt = [tracemalloc.Filter(True, "*profiler.py")]
+        grown = [d for d in s2.filter_traces(flt).compare_to(
+            s1.filter_traces(flt), "lineno") if d.size_diff > 0]
+        assert not grown, f"disabled profiler allocated: {grown}"
+
+    def test_env_off_switch_leaves_balancer_unwrapped(self, monkeypatch):
+        monkeypatch.setenv("CONFIG_whisk_profiling_enabled", "false")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            try:
+                assert bal.profiler.enabled is False
+                # wrap() returned the jitted callables unchanged: the
+                # dispatch hot path carries no profiler frame
+                assert not hasattr(bal._packed_fn, "_kernel_profiled")
+                assert not hasattr(bal._release_packed_fn,
+                                   "_kernel_profiled")
+                assert bal.profiler.cache_census() == {}
+            finally:
+                await bal.close()
+
+        asyncio.run(go())
+
+    def test_config_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("CONFIG_whisk_profiling_compileLog", "7")
+        monkeypatch.setenv("CONFIG_whisk_profiling_tailThresholdMs", "12.5")
+        p = KernelProfiler.from_config()
+        assert p.config.compile_log == 7
+        assert p.tail_threshold_ms == 12.5
+
+
+class TestBalancerIntegration:
+    def test_tpu_dispatch_profiles_and_churn_classifies_unexpected(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("profiled", memory=128)
+            msgs = [make_msg(action, ident, True) for _ in range(4)]
+            await asyncio.gather(*[await bal.publish(action, m)
+                                   for m in msgs])
+            prof_before = bal.kernel_profile()
+            # induce shape churn: a hand-rolled dispatch with a NON-pow2
+            # batch bucket (bp=12) — a shape _bucket() can never produce
+            rel = np.zeros((5, 8), np.int32)
+            rel[3] = 1
+            health = np.zeros((3, 64), np.int32)
+            req = np.zeros((9, 12), np.int32)
+            req[1] = 1
+            req[6] = 1
+            buf = np.concatenate([rel.ravel(), health.ravel(), req.ravel()])
+            bal.state, _ = bal._packed_fn(bal.state, buf, 8, 64, 12)
+            prof_after = bal.kernel_profile()
+            churn = bal.metrics.counter_value(
+                "loadbalancer_kernel_recompiles_total",
+                tags={"expected": "false"})
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return prof_before, prof_after, churn
+
+        before, after, churn = asyncio.run(go())
+        assert before["kernel"] in ("xla", "pallas")
+        # the dispatch cycle reported every phase
+        for phase in ("assembly", "dispatch", "readback", "fanout", "total"):
+            assert before["phases"][phase]["count"] >= 1, phase
+            assert before["phases"][phase]["p50_ms"] is not None
+        # the first fused-program compile is in the log, expected
+        assert before["compiles"]["expected"] >= 1
+        assert before["compiles"]["unexpected"] == 0
+        assert any(e["reason"] == "first_call"
+                   for e in before["compiles"]["log"])
+        assert "fused_step" in before["cache_census"]
+        assert isinstance(before["memory"], dict)
+        # the induced churn: classified expected=false, counter bumped
+        assert after["compiles"]["unexpected"] == 1
+        assert churn == 1
+        bad = [e for e in after["compiles"]["log"] if not e["expected"]]
+        assert bad and bad[-1]["reason"] == "shape_churn"
+
+    def test_tail_sampling_skips_fast_batches_in_flight_recorder(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            # every CPU-local batch completes far under 10 s: all sampled out
+            bal.profiler.tail_threshold_ms = 10_000.0
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("tails", memory=128)
+            msgs = [make_msg(action, ident, True) for _ in range(3)]
+            await asyncio.gather(*[await bal.publish(action, m)
+                                   for m in msgs])
+            n_records = len(bal.flight_recorder)
+            skipped = bal.profiler.tail_skipped
+            # gauges still refreshed for the sampled-out batches
+            healthy = bal.metrics.gauge_value("loadbalancer_healthy_invokers")
+            # a capture window overrides the sampler
+            bal.profiler.arm_capture(4)
+            more = [make_msg(action, ident, True) for _ in range(2)]
+            await asyncio.gather(*[await bal.publish(action, m)
+                                   for m in more])
+            n_after_capture = len(bal.flight_recorder)
+            captured = len(bal.profiler._capture_rows)
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return n_records, skipped, healthy, n_after_capture, captured
+
+        n_records, skipped, healthy, n_after, captured = asyncio.run(go())
+        assert n_records == 0 and skipped >= 1
+        assert healthy == 2
+        assert n_after >= 1          # capture forced full rows back on
+        assert captured >= 1
+        # captured steps carry full detail
+        # (decisions + timings ride the captured row)
+
+    def test_cpu_twins_drain_capture_windows(self):
+        """A capture window armed on a CPU twin must drain off its publish
+        path (one step per publish) — otherwise POST /admin/profile/capture
+        would arm a window that stays armed forever (and would never stop a
+        live jax.profiler trace) on sharding/lean deployments."""
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = ShardingBalancer(provider, ControllerInstanceId("0"),
+                                   managed_fraction=1.0,
+                                   blackbox_fraction=0.0)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("cpucap", memory=128)
+            bal.profiler.arm_capture(2)
+            for _ in range(3):
+                await (await bal.publish(action,
+                                         make_msg(action, ident, True)))
+            cap = bal.kernel_profile()["capture"]
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return cap
+
+        cap = asyncio.run(go())
+        assert cap["armed"] is False and cap["captured"] == 2
+        assert all(r["kernel"] == "cpu" and "total_ms" in r
+                   for r in cap["steps"])
+
+    def test_cpu_twins_answer_the_same_profile_shape(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = ShardingBalancer(provider, ControllerInstanceId("0"),
+                                   managed_fraction=1.0,
+                                   blackbox_fraction=0.0)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("cpuprof", memory=128)
+            msg = make_msg(action, ident, True)
+            await (await bal.publish(action, msg))
+            sharding = bal.kernel_profile()
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+
+            class _DummyInvoker:
+                async def stop(self):
+                    pass
+
+            async def factory(invoker_id, messaging_provider):
+                return _DummyInvoker()
+
+            lean = LeanBalancer(provider, ControllerInstanceId("1"), factory)
+            await lean.start()
+            msg2 = make_msg(action, ident, False)
+            await lean.publish(action, msg2)
+            lean_prof = lean.kernel_profile()
+            await lean.close()
+            return sharding, lean_prof
+
+        sharding, lean_prof = asyncio.run(go())
+        for prof, phase in ((sharding, "schedule"), (lean_prof, "dispatch")):
+            assert prof["kernel"] == "cpu"
+            assert prof["phases"][phase]["count"] >= 1
+            assert prof["phases"][phase]["p50_ms"] is not None
+            assert prof["compiles"]["log"] == []  # nothing jitted here
+            assert prof["capture"]["armed"] is False
+            assert isinstance(prof["memory"], dict)
+
+
+PORT = 13381
+
+
+class TestAdminEndpoints:
+    """GET /admin/profile/kernel + POST /admin/profile/capture on a live
+    controller HTTP surface, with a TpuBalancer placing through publish()."""
+
+    def _run(self, scenario):
+        from openwhisk_tpu.controller.core import Controller
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            controller = Controller(ControllerInstanceId("0"), provider,
+                                    load_balancer=bal)
+            ident = Identity.generate("guest")
+            await controller.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await controller.start(port=PORT)
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            hdrs = {"Authorization": "Basic " + base64.b64encode(
+                ident.authkey.compact.encode()).decode()}
+            try:
+                async with aiohttp.ClientSession() as s:
+                    return await scenario(bal, ident, s, hdrs)
+            finally:
+                await controller.stop()
+                for inv in invokers:
+                    await inv.stop()
+
+        return asyncio.run(go())
+
+    def test_auth_required(self):
+        async def scenario(bal, ident, s, hdrs):
+            out = {}
+            async with s.get(f"http://127.0.0.1:{PORT}"
+                             "/admin/profile/kernel") as r:
+                out["get"] = r.status
+            async with s.post(f"http://127.0.0.1:{PORT}"
+                              "/admin/profile/capture",
+                              json={"steps": 2}) as r:
+                out["post"] = r.status
+            return out
+
+        statuses = self._run(scenario)
+        assert statuses == {"get": 401, "post": 401}
+
+    def test_profile_and_capture_round_trip(self):
+        async def scenario(bal, ident, s, hdrs):
+            base = f"http://127.0.0.1:{PORT}/admin/profile"
+            action = make_action("adminprof", memory=128)
+            msgs = [make_msg(action, ident, True) for _ in range(3)]
+            await asyncio.gather(*[await bal.publish(action, m)
+                                   for m in msgs])
+            out = {}
+            async with s.get(base + "/kernel", headers=hdrs) as r:
+                out["profile"] = (r.status, await r.json())
+            async with s.post(base + "/capture", headers=hdrs,
+                              json={"steps": 2}) as r:
+                out["arm"] = (r.status, await r.json())
+            more = [make_msg(action, ident, True) for _ in range(2)]
+            for m in more:  # separate publishes: >= 2 dispatch steps
+                await (await bal.publish(action, m))
+            async with s.get(base + "/kernel", headers=hdrs) as r:
+                out["after"] = (r.status, await r.json())
+            async with s.post(base + "/capture", headers=hdrs,
+                              json={"steps": 0}) as r:
+                out["bad_steps"] = r.status
+            async with s.post(base + "/capture", headers=hdrs,
+                              json={"steps": "many"}) as r:
+                out["bad_type"] = r.status
+            return out
+
+        out = self._run(scenario)
+        status, prof = out["profile"]
+        assert status == 200
+        assert prof["enabled"] is True
+        assert prof["kernel"] in ("xla", "pallas")
+        assert prof["compiles"]["expected"] >= 1
+        for phase in ("assembly", "dispatch", "readback", "fanout"):
+            assert prof["phases"][phase]["p50_ms"] is not None
+            assert prof["phases"][phase]["p99_ms"] is not None
+        assert "fused_step" in prof["cache_census"]
+        assert isinstance(prof["memory"], dict)
+        status, armed = out["arm"]
+        assert status == 200 and armed["armed"] and armed["steps"] == 2
+        status, after = out["after"]
+        assert status == 200
+        assert after["capture"]["captured"] == 2
+        assert after["capture"]["armed"] is False
+        row = after["capture"]["steps"][0]
+        assert "timings" in row and "total_ms" in row and "decisions" in row
+        assert out["bad_steps"] == 400
+        assert out["bad_type"] == 400

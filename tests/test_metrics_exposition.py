@@ -174,6 +174,12 @@ class TestExpositionFormat:
                 await asyncio.sleep(0.3)
                 bal.telemetry.device_fold()
                 bal.telemetry.tick(bal.metrics)  # slo_* gauges on the page
+                # HBM gauges: the CPU backend has no memory_stats, so feed
+                # the guarded reader a canned answer — this validates the
+                # loadbalancer_hbm_* family names against the grammar
+                bal.profiler.memory_stats = lambda: {
+                    "bytes_in_use": 1 << 20, "bytes_limit": 1 << 30}
+                bal.profiler.refresh_memory(bal.metrics)
                 # a value that needs label escaping must not corrupt a line
                 bal.metrics.counter("exposition_escape_probe",
                                     tags={"metric": 'a"b\\c\nd'})
@@ -207,3 +213,18 @@ class TestExpositionFormat:
         fam_groups = [k for k in out["histograms"]
                       if k[0] == "openwhisk_namespace_activation_latency_seconds"]
         assert fam_groups, "no namespace latency series rendered"
+        # the kernel profiling plane's families (ISSUE 3): per-phase
+        # device timing as a REAL histogram family, the tagged recompile
+        # counter, and the HBM watermark gauges
+        assert types[
+            "openwhisk_loadbalancer_phase_duration_seconds"] == "histogram"
+        phase_groups = {dict(k[1]).get("phase") for k in out["histograms"]
+                        if k[0] ==
+                        "openwhisk_loadbalancer_phase_duration_seconds"}
+        assert {"assembly", "dispatch", "readback"} <= phase_groups
+        assert types[
+            "openwhisk_loadbalancer_kernel_recompiles_total"] == "counter"
+        assert 'openwhisk_loadbalancer_kernel_recompiles_total' \
+            '{expected="true"}' in text
+        assert types["openwhisk_loadbalancer_hbm_bytes_in_use"] == "gauge"
+        assert types["openwhisk_loadbalancer_hbm_utilization_ratio"] == "gauge"
