@@ -262,7 +262,8 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                     concurrency: int = 64, kernel: str = "auto",
                     flight_recorder: bool = True,
                     telemetry: bool = True,
-                    profiling: bool = True) -> dict:
+                    profiling: bool = True,
+                    anomaly: bool = True) -> dict:
     """TpuBalancer.publish() end-to-end on the in-memory bus with echo
     invokers: the full host path (slot alloc, micro-batch assembly, device
     step, promise fan-out, bus send) that the raw kernel number omits."""
@@ -287,6 +288,7 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                               ProfilingConfig(enabled=profiling)))
         bal.flight_recorder.enabled = flight_recorder
         bal.telemetry.enabled = telemetry
+        bal.anomaly.enabled = anomaly
         await bal.start()
         feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
         # wait until supervision has actually registered the fleet (a fixed
@@ -501,122 +503,136 @@ def _balancer_rows() -> dict:
     }
 
 
+def _cpu_subprocess_json(expr: str, marker: str, label: str,
+                         force_devices: bool = False) -> Optional[dict]:
+    """Evaluate one `bench.*` expression in a fresh subprocess pinned to
+    the CPU backend and parse its marker-prefixed JSON stdout line. A
+    fresh process is the only clean path once the in-process backend
+    registry has cached a device failure; `force_devices` adds the
+    8-virtual-device XLA flag for runs that need the full CPU mesh."""
+    import os
+    import subprocess
+    env_lines = ["import os, json", "os.environ['JAX_PLATFORMS'] = 'cpu'"]
+    if force_devices:
+        env_lines.append(
+            "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
+            "' --xla_force_host_platform_device_count=8'")
+    code = "\n".join(env_lines + [
+        "import jax",
+        "jax.config.update('jax_platforms', 'cpu')",
+        "import bench",
+        f"print('{marker}:' + json.dumps({expr}))",
+    ]) + "\n"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=1200)
+        for line in out.stdout.splitlines():
+            if line.startswith(marker + ":"):
+                return json.loads(line[len(marker) + 1:])
+        print(f"# {label} failed: {out.stderr[-400:]}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — auxiliary measure
+        print(f"# {label} failed: {e!r}", file=sys.stderr)
+    return None
+
+
 def _balancer_host_rows() -> Optional[dict]:
     """The same balancer rows forced onto the CPU backend in a subprocess:
     the HOST-PATH measure. Through a tunneled chip every device step costs a
     wire round trip (~70 ms here) that does not exist on a real TPU host
     (PCIe-local chips); the CPU-backend run shows what the host machinery
     itself sustains with the device round trip out of the picture."""
-    import os
-    import subprocess
-    code = (
-        "import os, json\n"
-        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
-        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
-        "' --xla_force_host_platform_device_count=8'\n"
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        "import bench\n"
-        "print('BENCHJSON:' + json.dumps(bench._balancer_rows()))\n")
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=1200)
-        for line in out.stdout.splitlines():
-            if line.startswith("BENCHJSON:"):
-                return json.loads(line[len("BENCHJSON:"):])
-        print(f"# balancer host-path run failed: {out.stderr[-400:]}",
-              file=sys.stderr)
-    except Exception as e:  # noqa: BLE001 — host row is auxiliary
-        print(f"# balancer host-path run failed: {e!r}", file=sys.stderr)
-    return None
+    return _cpu_subprocess_json("bench._balancer_rows()", "BENCHJSON",
+                                "balancer host-path run",
+                                force_devices=True)
 
 
-def _flight_recorder_overhead(repeats: int = 3, total: int = 1000,
-                              concurrency: int = 64) -> Optional[dict]:
-    """The observability tax: median XLA-kernel placement rate through the
-    full balancer path with the flight recorder ON vs OFF (the recorder
-    lives on the host publish/readback path, so the balancer-level rate —
-    not the raw kernel step — is where its cost can show). Acceptance gate:
-    overhead_pct <= 5 (ISSUE 1)."""
+def _plane_overhead(flag: str, key: str, repeats: int = 3, total: int = 1000,
+                    concurrency: int = 64) -> Optional[dict]:
+    """The observability tax, shared rider body: median XLA-kernel
+    placement rate through the full balancer path with one plane ON vs
+    OFF. Every plane lives somewhere on the dispatch/completion path, so
+    the balancer-level rate — not the raw kernel step — is where its cost
+    can show. `flag` is the _balancer_bench kwarg that toggles the plane,
+    `key` names the result fields (`rate_{key}_on/off`). Acceptance gate
+    for each plane: overhead_pct <= 5 (ISSUEs 1-4)."""
     try:
         on_rates, off_rates = [], []
         for _ in range(repeats):
             on_rates.append(_balancer_bench(
                 total=total, concurrency=concurrency, kernel="xla",
-                flight_recorder=True)["activations_per_sec"])
+                **{flag: True})["activations_per_sec"])
             off_rates.append(_balancer_bench(
                 total=total, concurrency=concurrency, kernel="xla",
-                flight_recorder=False)["activations_per_sec"])
+                **{flag: False})["activations_per_sec"])
         on = statistics.median(on_rates)
         off = statistics.median(off_rates)
         return {
-            "rate_recorder_on": round(on, 1),
-            "rate_recorder_off": round(off, 1),
+            f"rate_{key}_on": round(on, 1),
+            f"rate_{key}_off": round(off, 1),
             "overhead_pct": round(100.0 * (off - on) / off, 2) if off else None,
             "repeats": repeats,
         }
     except Exception as e:  # noqa: BLE001 — rider is auxiliary
-        print(f"# flight_recorder_overhead failed: {e!r}", file=sys.stderr)
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# {key}_overhead failed: {e!r}", file=sys.stderr)
         return None
 
 
-def _telemetry_overhead(repeats: int = 3, total: int = 1000,
-                        concurrency: int = 64) -> Optional[dict]:
-    """The device-telemetry tax: median XLA-kernel placement rate through
-    the full balancer path with the latency accumulator ON vs OFF. The
-    accumulator lives on the completion/dispatch path (observe() per ack +
-    one scatter-add fold per batch), so the balancer-level rate is where
-    its cost can show. Acceptance gate: overhead_pct <= 5 (ISSUE 2)."""
+# Named wrappers: _rider_subprocess_cpu re-invokes riders by attribute
+# name in a fresh CPU-pinned process, so each plane keeps a module-level
+# entry point.
+
+def _flight_recorder_overhead(**kw) -> Optional[dict]:
+    return _plane_overhead("flight_recorder", "recorder", **kw)
+
+
+def _telemetry_overhead(**kw) -> Optional[dict]:
+    return _plane_overhead("telemetry", "telemetry", **kw)
+
+
+def _profiling_overhead(**kw) -> Optional[dict]:
+    return _plane_overhead("profiling", "profiling", **kw)
+
+
+def _anomaly_overhead(**kw) -> Optional[dict]:
+    return _plane_overhead("anomaly", "anomaly", **kw)
+
+
+def _backend_unavailable(e: BaseException) -> bool:
+    """True for the LAZY backend-init failure mode: the subprocess probe
+    passed but the first dispatched op inside the measured run raised
+    (BENCH_r05 — the tunnel died between probe and run). jax surfaces it
+    as RuntimeError('Unable to initialize backend ...')."""
+    return isinstance(e, RuntimeError) and \
+        "nable to initialize backend" in str(e)
+
+
+def _rider_subprocess_cpu(fn_name: str) -> Optional[dict]:
+    """Re-run one overhead rider in a subprocess pinned to the CPU backend
+    (the in-process backend registry already cached the failure, so the
+    clean re-run needs a fresh process, like _balancer_host_rows)."""
+    return _cpu_subprocess_json(f"bench.{fn_name}()", "RIDERJSON",
+                                f"{fn_name} cpu re-run")
+
+
+def _run_rider(fn_name: str, fn) -> Optional[dict]:
+    """Run an overhead rider; when the backend dies LAZILY inside the
+    measured run (past the subprocess probe), re-run the rider under
+    JAX_PLATFORMS=cpu and tag the result `"backend": "cpu_fallback"` so
+    the emitted JSON line stays parseable and honest."""
     try:
-        on_rates, off_rates = [], []
-        for _ in range(repeats):
-            on_rates.append(_balancer_bench(
-                total=total, concurrency=concurrency, kernel="xla",
-                telemetry=True)["activations_per_sec"])
-            off_rates.append(_balancer_bench(
-                total=total, concurrency=concurrency, kernel="xla",
-                telemetry=False)["activations_per_sec"])
-        on = statistics.median(on_rates)
-        off = statistics.median(off_rates)
-        return {
-            "rate_telemetry_on": round(on, 1),
-            "rate_telemetry_off": round(off, 1),
-            "overhead_pct": round(100.0 * (off - on) / off, 2) if off else None,
-            "repeats": repeats,
-        }
-    except Exception as e:  # noqa: BLE001 — rider is auxiliary
-        print(f"# telemetry_overhead failed: {e!r}", file=sys.stderr)
-        return None
-
-
-def _profiling_overhead(repeats: int = 3, total: int = 1000,
-                        concurrency: int = 64) -> Optional[dict]:
-    """The kernel-profiler tax: median XLA-kernel placement rate through
-    the full balancer path with the profiling plane ON vs OFF. The plane
-    lives on the dispatch/readback path (one signature lookup per wrapped
-    call + one bucket increment per phase), so the balancer-level rate is
-    where its cost can show. Acceptance gate: overhead_pct <= 5 (ISSUE 3)."""
-    try:
-        on_rates, off_rates = [], []
-        for _ in range(repeats):
-            on_rates.append(_balancer_bench(
-                total=total, concurrency=concurrency, kernel="xla",
-                profiling=True)["activations_per_sec"])
-            off_rates.append(_balancer_bench(
-                total=total, concurrency=concurrency, kernel="xla",
-                profiling=False)["activations_per_sec"])
-        on = statistics.median(on_rates)
-        off = statistics.median(off_rates)
-        return {
-            "rate_profiling_on": round(on, 1),
-            "rate_profiling_off": round(off, 1),
-            "overhead_pct": round(100.0 * (off - on) / off, 2) if off else None,
-            "repeats": repeats,
-        }
-    except Exception as e:  # noqa: BLE001 — rider is auxiliary
-        print(f"# profiling_overhead failed: {e!r}", file=sys.stderr)
-        return None
+        return fn()
+    except RuntimeError as e:
+        if not _backend_unavailable(e):
+            raise
+        print(f"# {fn_name}: backend died mid-run ({e}); re-running under "
+              "JAX_PLATFORMS=cpu", file=sys.stderr)
+        out = _rider_subprocess_cpu(fn_name)
+        if out is not None:
+            out["backend"] = "cpu_fallback"
+        return out
 
 
 def _cpu_oracle_rate(n: int = N_INVOKERS, reqs: int = 2048) -> float:
@@ -743,10 +759,16 @@ def _run(args) -> Optional[dict]:
     recorder_overhead = None
     telemetry_overhead = None
     profiling_overhead = None
+    anomaly_overhead = None
     if not args.quick:
-        recorder_overhead = _flight_recorder_overhead()
-        telemetry_overhead = _telemetry_overhead()
-        profiling_overhead = _profiling_overhead()
+        recorder_overhead = _run_rider("_flight_recorder_overhead",
+                                       _flight_recorder_overhead)
+        telemetry_overhead = _run_rider("_telemetry_overhead",
+                                        _telemetry_overhead)
+        profiling_overhead = _run_rider("_profiling_overhead",
+                                        _profiling_overhead)
+        anomaly_overhead = _run_rider("_anomaly_overhead",
+                                      _anomaly_overhead)
         rows = _balancer_rows()
         # c64 stays flattened at the top level (older readers); the rows
         # dict carries the per-concurrency detail + phase breakdowns
@@ -835,6 +857,15 @@ def _run(args) -> Optional[dict]:
         out["telemetry_overhead"] = telemetry_overhead
     if profiling_overhead is not None:
         out["profiling_overhead"] = profiling_overhead
+    if anomaly_overhead is not None:
+        out["anomaly_overhead"] = anomaly_overhead
+    if any(isinstance(r, dict) and r.get("backend") == "cpu_fallback"
+           for r in (recorder_overhead, telemetry_overhead,
+                     profiling_overhead, anomaly_overhead)):
+        # a rider lost the device mid-run and re-ran on CPU: say so at the
+        # top level so trajectory readers never mistake a CPU number for a
+        # device number
+        out["backend"] = "cpu_fallback"
     if multi:
         out["multi_controller"] = multi
     return out

@@ -124,6 +124,10 @@ class ControllerApi:
         # stats, plus the on-demand capture window (auth-gated)
         r.add_get("/admin/profile/kernel", self.profile_kernel)
         r.add_post("/admin/profile/capture", self.profile_capture)
+        # anomaly & alerting plane: active/recent alerts and per-invoker
+        # anomaly scores with bucket-movement evidence (auth-gated)
+        r.add_get("/admin/alerts", self.alerts_report)
+        r.add_get("/admin/anomalies", self.anomalies_report)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -332,8 +336,19 @@ class ControllerApi:
     async def metrics(self, request):
         # worker thread: the balancer's telemetry renderer reads the
         # device-accumulated histogram counts, which forces a device->host
-        # sync that must not stall the event loop mid-step
-        text = await asyncio.to_thread(self.c.metrics.prometheus_text)
+        # sync that must not stall the event loop mid-step.
+        # A scrape that negotiates OpenMetrics (Prometheus sends this
+        # Accept header when exemplar scraping is on) gets the exemplar-
+        # annotated rendering + the required EOF marker; the classic text
+        # format never carries exemplars (its parsers reject them).
+        openmetrics = ("application/openmetrics-text"
+                       in request.headers.get("Accept", ""))
+        text = await asyncio.to_thread(self.c.metrics.prometheus_text,
+                                       openmetrics)
+        if openmetrics:
+            return web.Response(
+                text=text + "# EOF\n",
+                content_type="application/openmetrics-text")
         return web.Response(text=text, content_type="text/plain")
 
     # ------------------------------------------- placement introspection
@@ -447,6 +462,41 @@ class ControllerApi:
                           request.get("transid"))
         return web.json_response(prof.arm_capture(
             steps, trace_dir=trace_dir, tail_threshold_ms=ttl))
+
+    async def alerts_report(self, request):
+        """The alert plane: configured rules, active (pending + firing)
+        alerts, and the recent transition log from the alert ring.
+        `?limit=N` bounds the transition history (default 50)."""
+        plane = getattr(self.c.load_balancer, "anomaly", None)
+        if plane is None:
+            return _error(404, "this balancer has no anomaly plane",
+                          request.get("transid"))
+        try:
+            limit = max(0, int(request.query.get("limit", 50)))
+        except ValueError:
+            return _error(400, "limit must be an integer",
+                          request.get("transid"))
+        return web.json_response(plane.alerts_report(limit))
+
+    async def anomalies_report(self, request):
+        """Per-invoker anomaly scores (straggler / error-spike /
+        timeout-spike), flags, and evidence — which latency buckets moved
+        since the last detection tick. Device-path evidence forces a
+        device->host sync, so the report runs on a worker thread then
+        (same policy as /admin/slo)."""
+        lb = self.c.load_balancer
+        plane = getattr(lb, "anomaly", None)
+        if plane is None:
+            return _error(404, "this balancer has no anomaly plane",
+                          request.get("transid"))
+        names = None
+        if hasattr(lb, "_telemetry_invoker_names"):
+            names = lb._telemetry_invoker_names()
+        if plane.SYNCS_DEVICE:
+            report = await asyncio.to_thread(plane.anomalies_report, names)
+        else:
+            report = plane.anomalies_report(names)
+        return web.json_response(report)
 
     async def placement_occupancy(self, request):
         """Per-invoker slots-in-use/capacity derived from the balancer

@@ -1,3 +1,5 @@
+from .anomaly import (AlertEngine, AlertRule, AlertsConfig, AnomalyConfig,
+                      AnomalyPlane)
 from .base import (ActivationEntry, ActiveAckTimeout, CommonLoadBalancer,
                    InvokerHealth, LoadBalancer, LoadBalancerException,
                    LoadBalancerThrottleException,

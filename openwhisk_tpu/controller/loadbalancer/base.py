@@ -28,9 +28,11 @@ from ...messaging.connector import MessageFeed
 from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
                                   parse_ack)
 from ...utils.logging import MetricEmitter
+from ...utils.tracing import trace_id_of
 from ...utils.transaction import TransactionId
 from ...ops.profiler import KernelProfiler
 from ...ops.telemetry import (OUTCOME_ERROR, OUTCOME_SUCCESS, OUTCOME_TIMEOUT)
+from .anomaly import AnomalyPlane
 from .flight_recorder import BatchRecord, FlightRecorder
 from .telemetry import TelemetryPlane
 
@@ -47,14 +49,20 @@ USABLE_STATES = (HEALTHY, UNHEALTHY)  # ref: unhealthy still gets test traffic
 class InvokerHealth:
     id: InvokerInstanceId
     status: str = HEALTHY
+    #: advisory anomaly-plane hint (the name of a firing invoker-scoped
+    #: alert) — observability only, never part of usable/status decisions
+    hint: Optional[str] = None
 
     @property
     def usable(self) -> bool:
         return self.status in (HEALTHY,)
 
     def to_json(self):
-        return {"invoker": self.id.as_string, "status": self.status,
-                "userMemory": self.id.user_memory.to_json()}
+        out = {"invoker": self.id.as_string, "status": self.status,
+               "userMemory": self.id.user_memory.to_json()}
+        if self.hint is not None:
+            out["unhealthyHint"] = self.hint
+        return out
 
 
 class LoadBalancerException(Exception):
@@ -148,7 +156,8 @@ class CommonLoadBalancer(LoadBalancer):
                  metrics: Optional[MetricEmitter] = None,
                  flight_recorder: Optional[FlightRecorder] = None,
                  telemetry: Optional[TelemetryPlane] = None,
-                 profiler: Optional[KernelProfiler] = None):
+                 profiler: Optional[KernelProfiler] = None,
+                 anomaly: Optional[AnomalyPlane] = None):
         self.provider = messaging_provider
         self.controller = controller_instance
         self.logger = logger
@@ -182,6 +191,18 @@ class CommonLoadBalancer(LoadBalancer):
         self.profiler.metrics = self.metrics
         self._profiler_renderer = self.profiler.prometheus_text
         self.metrics.register_renderer(self._profiler_renderer)
+        # the anomaly & alerting plane (same hook pattern): per-invoker
+        # straggler/spike scores from the telemetry deltas — on device for
+        # the TPU balancer, the NumPy twin for CPU balancers — plus the
+        # Prometheus-style alert FSM, evaluated on the supervision tick
+        # (lean rides maybe_tick off the completion stream)
+        self.anomaly = (anomaly if anomaly is not None
+                        else AnomalyPlane.from_config(logger=logger))
+        self.anomaly.attach(telemetry=self.telemetry,
+                            profiler=self.profiler,
+                            invoker_names=self._telemetry_invoker_names)
+        self._anomaly_renderer = self.anomaly.prometheus_text
+        self.metrics.register_renderer(self._anomaly_renderer)
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -391,6 +412,12 @@ class CommonLoadBalancer(LoadBalancer):
         if not fr.enabled:
             return
         d = {"kernel": "cpu", "queue_depth": 0, "oldest_age_ms": 0.0}
+        tid = trace_id_of(getattr(msg, "trace_context", None))
+        if tid is not None:
+            # the row carries its trace: exemplar plumbing links the phase
+            # histogram's bucket lines back to this trace on OpenMetrics
+            # scrapes
+            d["trace_id"] = tid
         if digest:
             d.update(digest)
         rec = BatchRecord(digest=d, decisions=[(
@@ -424,6 +451,9 @@ class CommonLoadBalancer(LoadBalancer):
         # balancers without a supervision scheduler (lean) refresh the burn
         # gauges off the completion stream; tick() is internally 1 Hz-capped
         tp.maybe_tick(self.metrics)
+        # the anomaly plane rides the same cadence (no-op within 1 s of a
+        # supervision-tick evaluation, so TPU/sharding never double-tick)
+        self.anomaly.maybe_tick(self.metrics)
 
     def _telemetry_invoker_names(self) -> List[str]:
         """Invoker labels for the exposition/SLO surfaces, index-aligned
@@ -431,8 +461,9 @@ class CommonLoadBalancer(LoadBalancer):
         registry = getattr(self, "_registry", None)
         return [inv.as_string for inv in registry] if registry else []
 
-    def _telemetry_exposition(self) -> str:
-        return self.telemetry.prometheus_text(self._telemetry_invoker_names())
+    def _telemetry_exposition(self, openmetrics: bool = False) -> str:
+        return self.telemetry.prometheus_text(
+            self._telemetry_invoker_names(), openmetrics=openmetrics)
 
     # -- kernel profiling plane (shared hook, like the flight recorder) ----
     def kernel_profile(self) -> dict:
@@ -458,6 +489,7 @@ class CommonLoadBalancer(LoadBalancer):
                 entry.timeout_task.cancel()
         self.activation_slots.clear()
         # shared (process-wide) emitters outlive the balancer: stop
-        # contributing telemetry/profiling families once closed
+        # contributing telemetry/profiling/anomaly families once closed
         self.metrics.unregister_renderer(self._telemetry_renderer)
         self.metrics.unregister_renderer(self._profiler_renderer)
+        self.metrics.unregister_renderer(self._anomaly_renderer)

@@ -16,6 +16,7 @@ from typing import List, Optional
 
 from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
 from ...messaging.message import ActivationMessage
+from ...utils.tracing import trace_id_of
 from .base import HEALTHY, CommonLoadBalancer, InvokerHealth, LoadBalancer
 
 
@@ -45,8 +46,10 @@ class LeanBalancer(CommonLoadBalancer):
         dispatch_ms = (time.monotonic() - t0) * 1e3
         # lean mode's only data-plane hop: the in-process bus send, reported
         # as a dispatch phase so /admin/profile/kernel answers here too
+        # (traced publishes leave an exemplar on the bucket line)
         prof = self.profiler
-        prof.observe_phase("dispatch", dispatch_ms)
+        prof.observe_phase("dispatch", dispatch_ms,
+                           trace_id=trace_id_of(msg.trace_context))
         if prof.capture_armed:
             # each publish is one dispatch step here, so the capture
             # window drains (and stops any live trace) on lean too

@@ -15,6 +15,7 @@ from typing import List, Optional
 from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
 from ...messaging.message import ActivationMessage
 from ...models.sharding_policy import ShardingPolicyState, release, schedule
+from ...utils.tracing import export_tracing_gauges, trace_id_of
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth, LoadBalancerException)
 from .flight_recorder import occupancy_json
 from .supervision import InvokerPool
@@ -23,8 +24,10 @@ from .supervision import InvokerPool
 class ShardingBalancer(CommonLoadBalancer):
     def __init__(self, messaging_provider, controller_instance, logger=None,
                  metrics=None, cluster_size: int = 1,
-                 managed_fraction: float = 0.9, blackbox_fraction: float = 0.1):
-        super().__init__(messaging_provider, controller_instance, logger, metrics)
+                 managed_fraction: float = 0.9, blackbox_fraction: float = 0.1,
+                 anomaly=None):
+        super().__init__(messaging_provider, controller_instance, logger,
+                         metrics, anomaly=anomaly)
         self.policy = ShardingPolicyState.build(
             [], cluster_size=cluster_size, managed_fraction=managed_fraction,
             blackbox_fraction=blackbox_fraction)
@@ -35,14 +38,20 @@ class ShardingBalancer(CommonLoadBalancer):
             messaging_provider, on_status_change=self._status_change,
             logger=logger, group=f"health-{controller_instance.as_string}",
             on_tick=self._plane_tick)
+        # advisory unhealthy hints from the anomaly plane land on the
+        # supervision pool (pushed only when hintUnhealthy is configured)
+        self.anomaly.hint_sink = self.supervision.set_unhealthy_hints
         self._registry: List[InvokerInstanceId] = []
         self._usable: List[bool] = []
 
     def _plane_tick(self) -> None:
         self.telemetry.tick(self.metrics)
+        # anomaly detection over the NumPy twin rides the same 1 Hz tick
+        self.anomaly.tick(self.metrics)
         # guarded no-op on CPU backends — present so the profiling plane
         # behaves identically should this balancer run beside a device
         self.profiler.refresh_memory(self.metrics)
+        export_tracing_gauges(self.metrics)
 
     async def start(self) -> None:
         self.start_ack_feed()
@@ -80,8 +89,10 @@ class ShardingBalancer(CommonLoadBalancer):
             blackbox=meta.is_blackbox)
         schedule_ms = (time.monotonic() - t0) * 1e3
         # the CPU twin's "device step": the probe walk itself, reported as
-        # a schedule phase so /admin/profile/kernel answers p50/p99 here too
-        self.profiler.observe_phase("schedule", schedule_ms)
+        # a schedule phase so /admin/profile/kernel answers p50/p99 here
+        # too (traced publishes leave an exemplar on the bucket line)
+        self.profiler.observe_phase("schedule", schedule_ms,
+                                    trace_id=trace_id_of(msg.trace_context))
         if self.profiler.capture_armed:
             # each publish is one "dispatch step" for the CPU twin, so an
             # armed capture window drains (and stops any live trace) here

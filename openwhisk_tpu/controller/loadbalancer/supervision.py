@@ -78,6 +78,11 @@ class InvokerPool:
         self.ping_timeout = ping_timeout
         self.group = group
         self.invokers: Dict[int, InvokerActorState] = {}
+        #: advisory hints from the anomaly plane (invoker index -> firing
+        #: alert name). Observability only: the FSM's status derivation
+        #: never reads them — a flagged invoker still takes traffic until
+        #: real outcome evidence (the ring buffer) demotes it.
+        self.unhealthy_hints: Dict[int, str] = {}
         self._feed: Optional[MessageFeed] = None
         self._watchdog: Optional[Scheduler] = None
 
@@ -172,7 +177,14 @@ class InvokerPool:
                                  "InvokerPool")
             self.on_status_change(st.id, new_status)
 
+    def set_unhealthy_hints(self, hints: Dict[int, str]) -> None:
+        """Replace the advisory hint set (the anomaly plane pushes the full
+        current dict every tick when CONFIG_whisk_anomaly_hintUnhealthy is
+        on, so recovered invokers shed their hint automatically)."""
+        self.unhealthy_hints = dict(hints)
+
     # -- views -------------------------------------------------------------
     def health(self) -> List[InvokerHealth]:
-        return [InvokerHealth(st.id, st.status)
-                for _, st in sorted(self.invokers.items())]
+        return [InvokerHealth(st.id, st.status,
+                              hint=self.unhealthy_hints.get(idx))
+                for idx, st in sorted(self.invokers.items())]

@@ -219,8 +219,8 @@ class TelemetryPlane:
             self.device_fold(max_events=MAX_PENDING_EVENTS)
         return self.accumulator.counts()
 
-    def prometheus_text(self, invoker_names: Optional[List[str]] = None
-                        ) -> str:
+    def prometheus_text(self, invoker_names: Optional[List[str]] = None,
+                        openmetrics: bool = False) -> str:
         """The telemetry families in Prometheus exposition format — real
         `histogram` families with cumulative `le` buckets plus outcome
         counters (rendering in controller/monitoring.py)."""
@@ -252,13 +252,15 @@ class TelemetryPlane:
             [({"invoker": inv_name(i), "outcome": OUTCOME_NAMES[k]},
               int(c["inv_outcomes"][i, k]))
              for i in range(c["inv_outcomes"].shape[0])
-             for k in range(N_OUTCOMES) if c["inv_outcomes"][i, k]])
+             for k in range(N_OUTCOMES) if c["inv_outcomes"][i, k]],
+            openmetrics=openmetrics)
         out += counter_family_text(
             "openwhisk_namespace_activation_outcomes_total",
             [({"namespace": self._ns_label(s), "outcome": OUTCOME_NAMES[k]},
               int(c["ns_outcomes"][s, k]))
              for s in range(c["ns_outcomes"].shape[0])
-             for k in range(N_OUTCOMES) if c["ns_outcomes"][s, k]])
+             for k in range(N_OUTCOMES) if c["ns_outcomes"][s, k]],
+            openmetrics=openmetrics)
         return "\n".join(out)
 
     # -- burn rates (host counters only) -----------------------------------

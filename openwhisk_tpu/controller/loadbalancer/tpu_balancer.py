@@ -51,6 +51,7 @@ from ...ops.placement import (PlacementState, RequestBatch, init_state,
                               release_batch, schedule_batch, set_health,
                               unpack_chosen)
 from ...ops.throttle import init_buckets
+from ...utils.tracing import export_tracing_gauges, trace_id_of
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException, LoadBalancerThrottleException)
 from .flight_recorder import (BatchRecord, free_slot_histogram,
@@ -183,9 +184,9 @@ class TpuBalancer(CommonLoadBalancer):
                  initial_pad: int = 64, mesh=None, kernel: str = "auto",
                  pipeline_depth: int = 4,
                  rate_limit_per_minute: Optional[int] = None,
-                 profiler=None):
+                 profiler=None, anomaly=None):
         super().__init__(messaging_provider, controller_instance, logger,
-                         metrics, profiler=profiler)
+                         metrics, profiler=profiler, anomaly=anomaly)
         self._cluster_size = cluster_size
         self.kernel = kernel  # "auto" | "xla" | "pallas" (single-device)
         self.managed_fraction = managed_fraction
@@ -246,6 +247,9 @@ class TpuBalancer(CommonLoadBalancer):
             messaging_provider, on_status_change=self._status_change,
             logger=logger, group=f"health-{controller_instance.as_string}",
             on_tick=self._telemetry_tick)
+        # advisory unhealthy hints from the anomaly plane land on the
+        # supervision pool (pushed only when hintUnhealthy is configured)
+        self.anomaly.hint_sink = self.supervision.set_unhealthy_hints
         # completion telemetry accumulates ON DEVICE for this balancer: the
         # buffered event rows fold into the accumulator as one scatter-add
         # per dispatch cycle (_dispatch_batch / idle _device_step)
@@ -259,9 +263,14 @@ class TpuBalancer(CommonLoadBalancer):
         # still converge their device counts)
         self.telemetry.device_fold()
         self.telemetry.tick(self.metrics)
+        # anomaly detection rides the same tick: the device program
+        # dispatches now and its scores harvest NEXT tick (no device sync
+        # on the event loop, same rule as the burn-rate math)
+        self.anomaly.tick(self.metrics)
         # HBM watermark gauges ride the same 1 Hz tick (guarded no-op on
         # backends without memory_stats, e.g. CPU)
         self.profiler.refresh_memory(self.metrics)
+        export_tracing_gauges(self.metrics)
 
     # -- device state ------------------------------------------------------
     def _resolve_kernel(self) -> str:
@@ -561,9 +570,11 @@ class TpuBalancer(CommonLoadBalancer):
                ns_slot)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         # trailing fields feed the flight recorder: enqueue time (queue-age
-        # digest) and the activation/action ids for the decision row
+        # digest), the activation/action ids for the decision row, and the
+        # trace id (exemplar plumbing on OpenMetrics scrapes)
         self._pending.append((req, fut, slot_key, time.monotonic(),
-                              msg.activation_id.asString, fqn_str))
+                              msg.activation_id.asString, fqn_str,
+                              trace_id_of(msg.trace_context)))
         # inline fast path: with free pipeline capacity, dispatch NOW
         # (synchronously — the assembly+enqueue body has no awaits) when the
         # batch is full, or on an idle FAST device (sub-window round trips:
@@ -895,6 +906,11 @@ class TpuBalancer(CommonLoadBalancer):
                 "queue_depth": b + len(self._pending),
                 "oldest_age_ms": round((t0 - batch[0][3]) * 1e3, 3),
             })
+            tid = next((e[6] for e in batch if e[6]), None)
+            if tid is not None:
+                # the record carries a trace: the phase histogram's bucket
+                # line gets an exemplar pointing at it (OpenMetrics only)
+                rec.digest["trace_id"] = tid
         rel_np = self._release_packed()
         health_np = self._health_packed()
         # releases + health flips + schedule: ONE device program over ONE
@@ -1071,7 +1087,9 @@ class TpuBalancer(CommonLoadBalancer):
         self.metrics.histogram("loadbalancer_tpu_fanout_ms", fanout_ms)
         prof = self.profiler
         prof.observe_phase("fanout", fanout_ms)
-        prof.observe_phase("total", dt_ms)
+        prof.observe_phase("total", dt_ms,
+                           trace_id=(rec.digest.get("trace_id")
+                                     if rec is not None else None))
         if rec is not None:
             # tail sampling: with a threshold armed, full per-decision rows
             # are filed only for slow batches (a live capture window takes
@@ -1097,8 +1115,8 @@ class TpuBalancer(CommonLoadBalancer):
         if file:
             n_reg = len(self._registry)
             decisions = rec.decisions
-            for (req, fut, slot_key, t_enq, aid, act), ci, f, thr in zip(
-                    batch, chosen_np, forced_np, throttled_np):
+            for (req, fut, slot_key, t_enq, aid, act, _tid), ci, f, thr in \
+                    zip(batch, chosen_np, forced_np, throttled_np):
                 ci = int(ci)
                 name = (self._registry[ci].as_string
                         if 0 <= ci < n_reg else None)

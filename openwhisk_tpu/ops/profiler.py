@@ -106,9 +106,13 @@ def _sig_of(x: Any) -> Any:
 class _PhaseAgg:
     """Per-phase accumulation: log2 bucket counts (exposition) + a
     pre-sized sliding sample window (p50/p99 rollups). One bucket
-    increment and one window write per observation — no growth."""
+    increment and one window write per observation — no growth. Traced
+    observations additionally pin the latest exemplar on their bucket
+    (bucket_idx -> (labels, value_ms, unix_ts)), linking the histogram's
+    OpenMetrics rendering back to a trace."""
 
-    __slots__ = ("counts", "sum_ms", "count", "window", "cursor")
+    __slots__ = ("counts", "sum_ms", "count", "window", "cursor",
+                 "exemplars")
 
     def __init__(self, window: int):
         self.counts = np.zeros(len(PHASE_BOUNDS_MS) + 1, np.int64)
@@ -116,13 +120,17 @@ class _PhaseAgg:
         self.count = 0
         self.window = np.zeros(max(8, window), np.float64)
         self.cursor = 0
+        self.exemplars: Dict[int, tuple] = {}
 
-    def add(self, ms: float) -> None:
-        self.counts[int(np.searchsorted(_PHASE_BOUNDS, ms, "left"))] += 1
+    def add(self, ms: float, trace_id: Optional[str] = None) -> None:
+        b = int(np.searchsorted(_PHASE_BOUNDS, ms, "left"))
+        self.counts[b] += 1
         self.sum_ms += ms
         self.window[self.cursor] = ms
         self.cursor = (self.cursor + 1) % self.window.shape[0]
         self.count += 1
+        if trace_id is not None:
+            self.exemplars[b] = ({"trace_id": trace_id}, ms, time.time())
 
     def rollup(self) -> dict:
         n = min(self.count, self.window.shape[0])
@@ -269,7 +277,11 @@ class KernelProfiler:
         } for name, e in self._entries.items()}
 
     # -- per-phase device timing -------------------------------------------
-    def observe_phase(self, phase: str, ms: float) -> None:
+    def observe_phase(self, phase: str, ms: float,
+                      trace_id: Optional[str] = None) -> None:
+        """Fold one phase duration in. `trace_id` (from a flight-recorder
+        row that carried a trace context) pins an exemplar on the bucket
+        this observation lands in — rendered only on OpenMetrics scrapes."""
         if not self.enabled:
             return
         with self._phase_lock:
@@ -277,28 +289,34 @@ class KernelProfiler:
             if agg is None:
                 agg = _PhaseAgg(self.config.phase_window)
                 self._phases[phase] = agg
-            agg.add(ms)
+            agg.add(ms, trace_id)
 
     def phase_rollups(self) -> dict:
         with self._phase_lock:
             return {phase: agg.rollup()
                     for phase, agg in self._phases.items()}
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, openmetrics: bool = False) -> str:
         """The phase-duration histogram family, rendered through the same
         exposition helpers as the telemetry plane (register_renderer
-        hook). Empty while no phases observed (or disabled)."""
+        hook). Empty while no phases observed (or disabled). When the
+        scrape negotiated OpenMetrics, bucket lines carry the pinned
+        trace exemplars (the classic text format has no exemplar syntax,
+        so they are omitted there)."""
         if not self.enabled:
             return ""
         from ..controller.monitoring import histogram_family_text
         with self._phase_lock:
             rows = [(phase, agg.counts.copy(), agg.sum_ms)
                     for phase, agg in sorted(self._phases.items())]
+            exemplars = ({phase: dict(agg.exemplars)
+                          for phase, agg in self._phases.items()
+                          if agg.exemplars} if openmetrics else None)
         if not rows:
             return ""
         return "\n".join(histogram_family_text(
             "openwhisk_loadbalancer_phase_duration_seconds", "phase",
-            rows, PHASE_BOUNDS_MS))
+            rows, PHASE_BOUNDS_MS, exemplars=exemplars))
 
     # -- HBM / memory watermarks -------------------------------------------
     def memory_stats(self) -> dict:

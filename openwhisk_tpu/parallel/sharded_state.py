@@ -19,9 +19,31 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # newer jax exports shard_map at the top level (check_vma keyword)
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # jax 0.4.x: the experimental module (check_rep keyword)
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
 
 from ..ops.placement import PlacementState, RequestBatch, _mulmod
+
+
+def shard_map(f, mesh, in_specs, out_specs, **kwargs):
+    """Compat shim over the two shard_map generations: forward the
+    skip-replication-check flag under whichever keyword this jax spells it
+    (`check_vma` at the top level, `check_rep` in the experimental module)
+    and drop it entirely if neither is understood."""
+    import inspect
+
+    params = inspect.signature(_shard_map_impl).parameters
+    check = kwargs.pop("check_vma", kwargs.pop("check_rep", None))
+    if check is not None:
+        if "check_vma" in params:
+            kwargs["check_vma"] = check
+        elif "check_rep" in params:
+            kwargs["check_rep"] = check
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kwargs)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "inv") -> Mesh:

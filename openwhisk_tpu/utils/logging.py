@@ -130,22 +130,38 @@ class MetricEmitter:
                     for k, v in self._hist.items()},
             }
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, openmetrics: bool = False) -> str:
         """Render in Prometheus exposition format (ref core/monitoring):
-        one # TYPE line per family, tagged series as labels."""
+        one # TYPE line per family, tagged series as labels. `openmetrics`
+        is forwarded to renderers that declare the parameter (the phase
+        histogram attaches trace exemplars only then — the classic text
+        format has no exemplar syntax)."""
+        import inspect
         out = []
         snap = self.snapshot()
 
-        def emit(items, kind, render):
+        def emit(items, kind, render, om_total: bool = False):
+            # OpenMetrics counter naming: the family (# TYPE line) is
+            # suffix-free and every sample carries `_total` — the classic
+            # format types the full sample name. A negotiated OM scrape
+            # with the classic naming is rejected wholesale by
+            # Prometheus's OM parser.
             seen = set()
             for key in sorted(items):
                 fam = _prom_name(key[0])
+                name = key[0]
+                if om_total:
+                    base = (name[:-len("_total")]
+                            if name.endswith("_total") else name)
+                    fam = _prom_name(base)
+                    key = (base + "_total", key[1])
                 if fam not in seen:
                     seen.add(fam)
                     out.append(f"# TYPE {fam} {kind}")
-                out.append(render(_prom_series(key), items[key]))
+                out.append(render(_prom_series(key), items[(name, key[1])]))
 
-        emit(snap["counters"], "counter", lambda s_, v: f"{s_} {v}")
+        emit(snap["counters"], "counter", lambda s_, v: f"{s_} {v}",
+             om_total=openmetrics)
         emit(snap["gauges"], "gauge", lambda s_, v: f"{s_} {v}")
         emit(snap["histograms"], "summary",
              lambda s_, v: _summary_lines(s_, v))
@@ -153,7 +169,12 @@ class MetricEmitter:
             renderers = list(self._renderers)
         for render in renderers:
             try:
-                text = render()
+                try:
+                    params = inspect.signature(render).parameters
+                except (TypeError, ValueError):
+                    params = {}
+                text = (render(openmetrics=openmetrics)
+                        if "openmetrics" in params else render())
             except Exception:  # noqa: BLE001 — one broken renderer must
                 continue      # not take the whole scrape page down
             if text:

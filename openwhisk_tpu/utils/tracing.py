@@ -206,6 +206,12 @@ class Tracer:
         self.expiry = expiry_seconds
         self._stacks: Dict[str, List[Span]] = {}
         self._touched: Dict[str, float] = {}
+        #: finish_span calls that found nothing to finish (no stack for the
+        #: transid, or a span that was already finished/expired): each one
+        #: is a span silently lost to the trace — counted so a miswired
+        #: caller shows up in the tracing gauges instead of as a mystery
+        #: hole in the waterfall
+        self.orphan_finishes = 0
 
     def start_span(self, name: str, transid) -> Span:
         stack = self._stacks.setdefault(transid.id, [])
@@ -228,9 +234,11 @@ class Tracer:
         stack."""
         stack = self._stacks.get(transid.id)
         if not stack:
+            self.orphan_finishes += 1
             return None
         if span is not None:
             if span not in stack:
+                self.orphan_finishes += 1
                 return None
             stack.remove(span)
         else:
@@ -304,6 +312,29 @@ class Tracer:
         for tid in [t for t, at in self._touched.items() if at < cutoff]:
             self._stacks.pop(tid, None)
             self._touched.pop(tid, None)
+
+
+def trace_id_of(context: Optional[Dict[str, str]]) -> Optional[str]:
+    """The trace id carried by a serialized W3C traceparent context, or
+    None when the context is absent or malformed (exemplar plumbing:
+    histogram bucket lines link back to traces by this id)."""
+    if not context:
+        return None
+    parts = context.get("traceparent", "").split("-")
+    return parts[1] if len(parts) == 4 and parts[1] else None
+
+
+def export_tracing_gauges(metrics, tracer: Optional["Tracer"] = None) -> None:
+    """Refresh the tracing health gauges on a MetricEmitter (ridden by the
+    balancers' supervision tick): span send/drop counts from the live
+    reporter, open transaction stacks, and orphan finish_span calls —
+    the silent-return path that used to be invisible."""
+    t = tracer if tracer is not None else GLOBAL_TRACER
+    metrics.gauge("tracing_orphan_finishes", t.orphan_finishes)
+    metrics.gauge("tracing_active_transactions", len(t._stacks))
+    rep = t.reporter
+    metrics.gauge("tracing_spans_sent", getattr(rep, "sent_spans", 0))
+    metrics.gauge("tracing_spans_dropped", getattr(rep, "dropped_spans", 0))
 
 
 # process-wide default tracer (ref WhiskTracerProvider)

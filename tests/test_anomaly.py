@@ -1,0 +1,508 @@
+"""On-device anomaly & straggler detection + alerting plane (ISSUE 4).
+
+Covers: the jitted anomaly step vs its NumPy twin (parity); straggler
+scoring flags exactly the slow invoker (min-samples gated); error-spike
+z-tests against the EWMA baseline; the alert FSM's pending/for-duration/
+firing/resolved lifecycle with transitions in the ring log; rule overrides
+from CONFIG_whisk_alerts_rules; straggler injection end-to-end through a
+live TpuBalancer (device accumulator + device detector, recovery included);
+the advisory unhealthy hints; both admin endpoints (auth + shape); and the
+disabled-plane true no-op.
+"""
+import asyncio
+import base64
+import time
+
+import aiohttp
+import numpy as np
+import pytest
+
+from openwhisk_tpu.controller.loadbalancer import (AlertsConfig,
+                                                   AnomalyConfig,
+                                                   AnomalyPlane,
+                                                   ShardingBalancer,
+                                                   TelemetryConfig,
+                                                   TelemetryPlane,
+                                                   TpuBalancer)
+from openwhisk_tpu.controller.loadbalancer.anomaly import (AlertEngine,
+                                                           AlertRule,
+                                                           build_rules)
+from openwhisk_tpu.controller.loadbalancer.supervision import InvokerPool
+from openwhisk_tpu.core.entity import (ControllerInstanceId, Identity,
+                                       WhiskAuthRecord)
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+from openwhisk_tpu.ops.anomaly import (S_ANOMALY_FLAG, S_ERR_SPIKE,
+                                       S_EWMA_MS, S_STRAGGLER,
+                                       S_STRAGGLER_FLAG, S_TOTAL,
+                                       anomaly_step_np, init_anomaly,
+                                       init_anomaly_np, make_anomaly_step)
+from openwhisk_tpu.ops.telemetry import (OUTCOME_ERROR, OUTCOME_SUCCESS,
+                                         OUTCOME_TIMEOUT)
+from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+CFG = dict(alpha=0.3, z_threshold=3.5, spike_threshold=3.0, min_samples=8,
+           mad_floor_ms=1.0)
+
+
+def _telemetry(counts_ms):
+    """Build cumulative telemetry arrays from per-invoker lists of
+    (n_samples, mean_latency_ms, n_err, n_tm) accumulated so far."""
+    n = len(counts_ms)
+    buckets = np.zeros((n, 12), np.int64)
+    lat = np.zeros((n,), np.float64)
+    out = np.zeros((n, 3), np.int64)
+    for i, (cnt, mean_ms, n_err, n_tm) in enumerate(counts_ms):
+        b = min(11, max(0, int(np.ceil(np.log2(max(mean_ms, 1e-3))))))
+        buckets[i, b] = cnt
+        lat[i] = cnt * mean_ms
+        out[i, OUTCOME_ERROR] = n_err
+        out[i, OUTCOME_TIMEOUT] = n_tm
+        out[i, OUTCOME_SUCCESS] = cnt - n_err - n_tm
+    return buckets, lat, out
+
+
+class TestKernelMath:
+    def test_device_matches_numpy_twin(self):
+        rng = np.random.RandomState(5)
+        st_np = init_anomaly_np(8, 12)
+        st_dev = init_anomaly(8, 12)
+        step = make_anomaly_step(*CFG.values())
+        cum = np.zeros((8, 4))
+        for _ in range(4):
+            cum[:, 0] += rng.randint(0, 30, 8)           # samples
+            cum[:, 1] = rng.uniform(1, 50, 8)            # mean ms this tick
+            cum[:, 2] += rng.randint(0, 3, 8)            # errors
+            cum[:, 3] += rng.randint(0, 2, 8)            # timeouts
+            rows = [(int(c[0]), float(c[1]), min(int(c[2]), int(c[0])),
+                     min(int(c[3]), int(c[0]) - int(c[2])))
+                    for c in cum]
+            buckets, lat, out = _telemetry(rows)
+            st_np, sc_np = anomaly_step_np(st_np, buckets, lat, out,
+                                           *CFG.values())
+            st_dev, sc_dev = step(st_dev, buckets.astype(np.int32),
+                                  lat.astype(np.float32),
+                                  out.astype(np.int32))
+            assert np.allclose(np.asarray(sc_dev), sc_np,
+                               rtol=1e-3, atol=1e-3)
+
+    def test_straggler_flags_only_slow_invoker(self):
+        st = init_anomaly_np(4, 12)
+        buckets, lat, out = _telemetry([(20, 2.0, 0, 0), (20, 2.2, 0, 0),
+                                        (20, 1.8, 0, 0), (20, 20.0, 0, 0)])
+        st, sc = anomaly_step_np(st, buckets, lat, out, *CFG.values())
+        assert list(sc[S_STRAGGLER_FLAG]) == [0.0, 0.0, 0.0, 1.0]
+        assert sc[S_STRAGGLER, 3] > 3.5
+        assert abs(sc[S_STRAGGLER, 0]) < 1.0  # fleet jitter never flags
+        assert sc[S_EWMA_MS, 3] == pytest.approx(20.0)
+
+    def test_min_samples_gates_flags(self):
+        st = init_anomaly_np(4, 12)
+        # the slow invoker has only 3 cumulative samples (< min_samples=8)
+        buckets, lat, out = _telemetry([(20, 2.0, 0, 0), (20, 2.0, 0, 0),
+                                        (20, 2.0, 0, 0), (3, 40.0, 0, 0)])
+        st, sc = anomaly_step_np(st, buckets, lat, out, *CFG.values())
+        assert sc[S_STRAGGLER, 3] > 3.5       # the score is visible
+        assert sc[S_STRAGGLER_FLAG, 3] == 0.0  # but the flag is gated
+
+    def test_error_spike_scores_burst_not_steady_floor(self):
+        st = init_anomaly_np(2, 12)
+        # three clean ticks build a clean baseline for invoker 0
+        cnt = err = 0
+        for _ in range(3):
+            cnt += 30
+            b, l, o = _telemetry([(cnt, 2.0, err, 0), (cnt, 2.0, 0, 0)])
+            st, sc = anomaly_step_np(st, b, l, o, *CFG.values())
+            assert sc[S_ERR_SPIKE, 0] == pytest.approx(0.0, abs=1e-6)
+        # a burst: 15 of the next 30 completions error
+        cnt += 30
+        err += 15
+        b, l, o = _telemetry([(cnt, 2.0, err, 0), (cnt, 2.0, 0, 0)])
+        st, sc = anomaly_step_np(st, b, l, o, *CFG.values())
+        assert sc[S_ERR_SPIKE, 0] > 3.0
+        assert sc[S_ANOMALY_FLAG, 0] == 1.0
+        assert sc[S_ERR_SPIKE, 1] == pytest.approx(0.0, abs=1e-6)
+
+    def test_growth_pads_state(self):
+        plane = AnomalyPlane(AnomalyConfig(), AlertsConfig())
+        tp = TelemetryPlane(TelemetryConfig(namespaces=8,
+                                            shared_namespace_buckets=2))
+        plane.attach(telemetry=tp)
+        tp.observe(1, "ns", 5.0, OUTCOME_SUCCESS)
+        plane.tick(now=1.0)
+        n0 = plane._scores.shape[1]
+        tp.observe(n0 + 3, "ns", 5.0, OUTCOME_SUCCESS)  # grows the axis
+        plane.tick(now=2.0)
+        assert plane._scores.shape[1] > n0
+        # the original invoker's EWMA survived the growth re-pad
+        assert plane._scores[S_EWMA_MS, 1] == pytest.approx(5.0)
+
+
+class TestAlertFSM:
+    def _engine(self, for_s=5.0, threshold=3.0):
+        rule = AlertRule("straggler", "straggler_score", threshold, for_s,
+                         "warning", "invoker")
+        return AlertEngine({"straggler": rule}), rule
+
+    def _sig(self, value, name="invoker3"):
+        return {"straggler": [((("invoker", name),), value)]}
+
+    def test_pending_for_duration_firing_resolved(self):
+        e, rule = self._engine(for_s=5.0)
+        e.evaluate(100.0, self._sig(9.0))
+        assert e.active(100.0)[0]["state"] == "pending"
+        e.evaluate(103.0, self._sig(9.5))   # inside the for window
+        assert e.active(103.0)[0]["state"] == "pending"
+        e.evaluate(105.5, self._sig(9.5))   # for-duration elapsed
+        act = e.active(105.5)
+        assert act[0]["state"] == "firing"
+        assert act[0]["labels"] == {"invoker": "invoker3"}
+        assert e.firing_counts() == {("straggler", "warning"): 1}
+        e.evaluate(110.0, self._sig(0.5))   # recovered
+        assert e.active() == [] and e.firing_counts() == {}
+        tos = [t["to"] for t in e.log.last(10)]
+        assert tos == ["pending", "firing", "resolved"]
+        assert e.transition_counts[("straggler", "firing")] == 1
+        assert e.transition_counts[("straggler", "resolved")] == 1
+
+    def test_zero_for_duration_fires_immediately(self):
+        e, _ = self._engine(for_s=0.0)
+        e.evaluate(1.0, self._sig(9.0))
+        assert e.active()[0]["state"] == "firing"
+
+    def test_pending_below_threshold_cancels(self):
+        e, _ = self._engine(for_s=60.0)
+        e.evaluate(1.0, self._sig(9.0))
+        e.evaluate(2.0, self._sig(1.0))
+        assert e.active() == []
+        assert e.log.last(5)[-1]["to"] == "cancelled"
+
+    def test_vanished_subject_resolves(self):
+        e, _ = self._engine(for_s=0.0)
+        e.evaluate(1.0, self._sig(9.0))
+        assert e.firing_counts()
+        e.evaluate(2.0, {"straggler": []})  # invoker left the fleet
+        assert e.active() == []
+        assert e.log.last(5)[-1]["to"] == "resolved"
+
+    def test_rules_from_env(self, monkeypatch):
+        monkeypatch.setenv(
+            "CONFIG_whisk_alerts_rules",
+            '{"straggler": {"threshold": 1.5, "for_s": 2, '
+            '"severity": "critical"}, '
+            '"timeout_spike": {"enabled": false}, '
+            '"my_burn": {"signal": "burn_rate_1m", "threshold": 2.5}}')
+        monkeypatch.setenv("CONFIG_whisk_anomaly_zThreshold", "2.0")
+        plane = AnomalyPlane.from_config()
+        assert plane.config.z_threshold == 2.0
+        r = plane.engine.rules
+        assert r["straggler"].threshold == 1.5
+        assert r["straggler"].for_s == 2.0
+        assert r["straggler"].severity == "critical"
+        assert r["timeout_spike"].enabled is False
+        assert r["my_burn"].signal == "burn_rate_1m" \
+            and r["my_burn"].scope == "global"
+        # untouched built-ins keep their defaults
+        assert r["slo_fast_burn"].threshold == 14.4
+
+    def test_builtin_thresholds_track_anomaly_config(self):
+        # one knob: the kernel's flag gate and the built-in alert gate
+        # must agree when the operator tunes the anomaly config
+        rules = build_rules(None, anomaly=AnomalyConfig(
+            z_threshold=2.5, spike_threshold=2.0))
+        assert rules["straggler"].threshold == 2.5
+        assert rules["error_spike"].threshold == 2.0
+        assert rules["timeout_spike"].threshold == 2.0
+        # an explicit alerts-rules override still wins over the derivation
+        rules = build_rules({"straggler": {"threshold": 4.0}},
+                            anomaly=AnomalyConfig(z_threshold=2.5))
+        assert rules["straggler"].threshold == 4.0
+        plane = AnomalyPlane(AnomalyConfig(z_threshold=2.5))
+        assert plane.engine.rules["straggler"].threshold == 2.5
+
+    def test_burn_rate_rule_rides_telemetry_windows(self):
+        plane = AnomalyPlane(
+            AnomalyConfig(),
+            AlertsConfig(rules={"slo_fast_burn": {"for_s": 0}}))
+        tp = TelemetryPlane(TelemetryConfig(namespaces=8,
+                                            shared_namespace_buckets=2))
+        plane.attach(telemetry=tp)
+        for _ in range(50):
+            tp.observe(0, "ns", 1.0, OUTCOME_ERROR)  # 100% errors
+        plane.tick(now=time.monotonic())
+        assert plane.engine.firing_counts().get(
+            ("slo_fast_burn", "critical")) == 1
+
+    def test_recompile_churn_rule(self):
+        class FakeProf:
+            enabled = True
+            compiles_unexpected = 0
+
+        plane = AnomalyPlane(AnomalyConfig(), AlertsConfig())
+        prof = FakeProf()
+        plane.attach(profiler=prof)
+        t0 = time.monotonic()
+        plane.tick(now=t0)
+        assert plane.engine.firing_counts() == {}
+        prof.compiles_unexpected = 3  # churn since last tick
+        plane.tick(now=t0 + 1)
+        assert plane.engine.firing_counts().get(
+            ("recompile_churn", "warning")) == 1
+        # churn ages out of the 60 s hold window -> resolved
+        plane.tick(now=t0 + 120)
+        assert plane.engine.firing_counts() == {}
+
+
+class TestDisabledNoOp:
+    def test_plane_is_inert(self):
+        plane = AnomalyPlane(AnomalyConfig(enabled=False))
+        tp = TelemetryPlane(TelemetryConfig(namespaces=8,
+                                            shared_namespace_buckets=2))
+        plane.attach(telemetry=tp)
+        tp.observe(0, "ns", 500.0, OUTCOME_SUCCESS)
+        assert plane.tick() == {}
+        plane.maybe_tick()
+        assert plane._state is None and plane._scores is None
+        assert plane.prometheus_text() == ""
+        assert plane.alerts_report() == {"enabled": False}
+        assert plane.anomalies_report() == {"enabled": False}
+
+    def test_env_off_switch_through_balancer(self, monkeypatch):
+        monkeypatch.setenv("CONFIG_whisk_anomaly_enabled", "false")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("darkanom", memory=128)
+            msg = make_msg(action, ident, True)
+            await (await bal.publish(action, msg))
+            await asyncio.sleep(0.2)
+            bal.telemetry.device_fold()
+            bal.anomaly.tick(bal.metrics)
+            out = (bal.anomaly.enabled, bal.anomaly._state,
+                   bal.anomaly.prometheus_text())
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return out
+
+        enabled, state, text = asyncio.run(go())
+        assert enabled is False and state is None and text == ""
+
+
+class TestHints:
+    def test_pool_surfaces_hints(self):
+        pool = InvokerPool(MemoryMessagingProvider())
+        from openwhisk_tpu.core.entity import InvokerInstanceId, MB
+        pool.on_ping(InvokerInstanceId(0, user_memory=MB(512)))
+        pool.set_unhealthy_hints({0: "straggler"})
+        h = pool.health()
+        assert h[0].hint == "straggler"
+        assert h[0].to_json()["unhealthyHint"] == "straggler"
+        # advisory only: status derivation is untouched
+        assert h[0].status == "up"
+        pool.set_unhealthy_hints({})
+        assert pool.health()[0].hint is None
+
+    def test_hint_sink_gated_by_config(self):
+        for hint_on in (True, False):
+            plane = AnomalyPlane(
+                AnomalyConfig(min_samples=4, hint_unhealthy=hint_on),
+                AlertsConfig(rules={"straggler": {"for_s": 0}}))
+            tp = TelemetryPlane(TelemetryConfig(namespaces=8,
+                                                shared_namespace_buckets=2))
+            got = {}
+            plane.attach(telemetry=tp,
+                         invoker_names=lambda: [f"invoker{i}"
+                                                for i in range(4)],
+                         hint_sink=lambda h: got.update(h))
+            for _ in range(10):
+                for i in range(3):
+                    tp.observe(i, "ns", 2.0, OUTCOME_SUCCESS)
+                tp.observe(3, "ns", 50.0, OUTCOME_SUCCESS)
+            plane.tick(now=time.monotonic())
+            assert plane.hints == {3: "straggler"}
+            assert (got == {3: "straggler"}) is hint_on
+
+
+class TestStragglerEndToEnd:
+    """The acceptance scenario: one invoker's completions delayed ~10x,
+    through a live TpuBalancer (device accumulator + device detector)."""
+
+    def test_flag_fire_recover(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            plane = AnomalyPlane(
+                AnomalyConfig(alpha=0.6, min_samples=6, mad_floor_ms=2.0),
+                AlertsConfig(rules={"straggler": {"for_s": 0.3}}))
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              anomaly=plane)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4)
+            # 0.12 s vs sub-ms: under scheduler load the concurrent
+            # publish gather inflates the "fast" invokers' e2e EWMAs to
+            # ~10 ms, so the separation must stay an order of magnitude
+            # above that noise floor for the robust z to be deterministic
+            invokers[3].delay = 0.12
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            actions = [make_action(f"e2e{i}", memory=128) for i in range(16)]
+
+            async def round_trip():
+                msgs = [(a, make_msg(a, ident, True)) for a in actions]
+                promises = [await bal.publish(a, m) for a, m in msgs]
+                await asyncio.gather(*promises)
+
+            async def settle(n=5):
+                # the device detector harvests one tick late, and the
+                # straggler rule holds pending for its 0.3 s for-duration:
+                # five 0.25 s ticks cover both with margin
+                for _ in range(n):
+                    bal.telemetry.device_fold()
+                    plane.tick(bal.metrics)
+                    await asyncio.sleep(0.25)
+
+            for _ in range(4):
+                await round_trip()
+            await settle()
+            rep1 = await asyncio.to_thread(
+                plane.anomalies_report, bal._telemetry_invoker_names())
+            alerts1 = plane.alerts_report()
+            text1 = bal.metrics.prometheus_text()
+            # recovery: the slow invoker speeds back up
+            invokers[3].delay = 0.0
+            for _ in range(6):
+                await round_trip()
+                await settle(1)
+            await settle()
+            rep2 = await asyncio.to_thread(
+                plane.anomalies_report, bal._telemetry_invoker_names())
+            alerts2 = plane.alerts_report()
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return rep1, alerts1, text1, rep2, alerts2
+
+        rep1, alerts1, text1, rep2, alerts2 = asyncio.run(go())
+
+        # exactly the delayed invoker is flagged, with bucket evidence
+        assert rep1["enabled"] is True and rep1["kernel"] == "device"
+        flagged = [r for r in rep1["invokers"] if r["straggler"]]
+        assert [r["invoker"] for r in flagged] == ["invoker3"]
+        assert flagged[0]["straggler_score"] > 3.5
+        assert flagged[0]["ewma_latency_ms"] > 10.0
+        # every active invoker carries bucket-movement evidence fields
+        assert all("evidence" in r for r in rep1["invokers"])
+
+        # the straggler alert went pending -> firing for invoker3
+        trans = [t for t in alerts1["transitions"]
+                 if t["alert"] == "straggler"]
+        assert [t["to"] for t in trans[:2]] == ["pending", "firing"]
+        assert all(t["labels"] == {"invoker": "invoker3"} for t in trans)
+        assert any(a["alert"] == "straggler" and a["state"] == "firing"
+                   for a in alerts1["active"])
+
+        # all three new families render on the shared /metrics page
+        assert ("# TYPE openwhisk_loadbalancer_invoker_anomaly_score gauge"
+                in text1)
+        assert ('openwhisk_alerts_firing{alertname="straggler"'
+                in text1)
+        assert ('openwhisk_alert_transitions_total{alertname="straggler"'
+                ',transition="firing"} 1') in text1
+
+        # after recovery: flag cleared, the firing alert resolved, nothing
+        # active. Under suite load the fleet median jitters a few ms, so a
+        # marginal re-breach (pending -> cancelled) may trail the resolve
+        # in the log — the resolved transition and the empty active set are
+        # the contract, not the literal last log entry.
+        assert [r["invoker"] for r in rep2["invokers"]
+                if r["straggler"]] == []
+        targets2 = [t["to"] for t in alerts2["transitions"]
+                    if t["alert"] == "straggler"]
+        assert "resolved" in targets2[targets2.index("firing"):]
+        assert not any(a["alert"] == "straggler"
+                       for a in alerts2["active"])
+
+
+PORT = 13380
+
+
+class TestAdminEndpoints:
+    def _run(self, scenario):
+        from openwhisk_tpu.controller.core import Controller
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            controller = Controller(ControllerInstanceId("0"), provider,
+                                    load_balancer=bal)
+            ident = Identity.generate("guest")
+            await controller.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await controller.start(port=PORT)
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            hdrs = {"Authorization": "Basic " + base64.b64encode(
+                ident.authkey.compact.encode()).decode()}
+            try:
+                async with aiohttp.ClientSession() as s:
+                    return await scenario(bal, ident, s, hdrs)
+            finally:
+                await controller.stop()
+                for inv in invokers:
+                    await inv.stop()
+
+        return asyncio.run(go())
+
+    def test_auth_required(self):
+        async def scenario(bal, ident, s, hdrs):
+            out = []
+            for path in ("/admin/alerts", "/admin/anomalies"):
+                async with s.get(f"http://127.0.0.1:{PORT}{path}") as r:
+                    out.append(r.status)
+            return out
+
+        assert self._run(scenario) == [401, 401]
+
+    def test_report_shapes_under_live_balancer(self):
+        async def scenario(bal, ident, s, hdrs):
+            action = make_action("anomseen", memory=128)
+            msgs = [make_msg(action, ident, True) for _ in range(6)]
+            await asyncio.gather(*[await bal.publish(action, m)
+                                   for m in msgs])
+            await asyncio.sleep(0.3)
+            bal.telemetry.device_fold()
+            bal.anomaly.tick(bal.metrics)
+            await asyncio.sleep(0.05)
+            bal.anomaly.tick(bal.metrics)  # device path: harvest tick
+            out = {}
+            for name, path in (("alerts", "/admin/alerts?limit=5"),
+                               ("anomalies", "/admin/anomalies")):
+                async with s.get(f"http://127.0.0.1:{PORT}{path}",
+                                 headers=hdrs) as r:
+                    out[name] = (r.status, await r.json())
+            return out
+
+        out = self._run(scenario)
+        status, alerts = out["alerts"]
+        assert status == 200 and alerts["enabled"] is True
+        rule_names = {r["name"] for r in alerts["rules"]}
+        assert {"straggler", "error_spike", "slo_fast_burn",
+                "slo_slow_burn", "recompile_churn"} <= rule_names
+        assert {"active", "transitions", "transitions_dropped"} <= \
+            set(alerts)
+        status, anom = out["anomalies"]
+        assert status == 200 and anom["enabled"] is True
+        assert anom["kernel"] == "device"
+        assert {"config", "fleet", "invokers"} <= set(anom)
+        assert anom["invokers"], "active invokers must report scores"
+        row = anom["invokers"][0]
+        assert {"invoker", "straggler_score", "error_spike_score",
+                "timeout_spike_score", "straggler", "anomalous",
+                "ewma_latency_ms", "samples", "evidence"} <= set(row)

@@ -174,6 +174,25 @@ class TestExpositionFormat:
                 await asyncio.sleep(0.3)
                 bal.telemetry.device_fold()
                 bal.telemetry.tick(bal.metrics)  # slo_* gauges on the page
+                # anomaly plane: two ticks (the device path harvests its
+                # scores one tick late), then inject a synthetic firing
+                # alert so all three new families render. Alert evaluation
+                # is frozen afterwards so a racing supervision tick cannot
+                # resolve the injected instance before the scrape.
+                bal.anomaly.tick(bal.metrics)
+                bal.anomaly.tick(bal.metrics)
+                from openwhisk_tpu.controller.loadbalancer import \
+                    AlertsConfig
+                lbl = ((("invoker", "invoker0"),), 99.0)
+                now = __import__("time").monotonic()
+                bal.anomaly.engine.evaluate(now, {"straggler": [lbl]})
+                bal.anomaly.engine.evaluate(now + 31, {"straggler": [lbl]})
+                bal.anomaly.alerts_config = AlertsConfig(enabled=False)
+                # tracing health gauges normally ride the supervision
+                # tick; refresh them deterministically for the scrape
+                from openwhisk_tpu.utils.tracing import \
+                    export_tracing_gauges
+                export_tracing_gauges(bal.metrics)
                 # HBM gauges: the CPU backend has no memory_stats, so feed
                 # the guarded reader a canned answer — this validates the
                 # loadbalancer_hbm_* family names against the grammar
@@ -228,3 +247,157 @@ class TestExpositionFormat:
             '{expected="true"}' in text
         assert types["openwhisk_loadbalancer_hbm_bytes_in_use"] == "gauge"
         assert types["openwhisk_loadbalancer_hbm_utilization_ratio"] == "gauge"
+        # the anomaly & alerting plane's families (ISSUE 4)
+        assert types[
+            "openwhisk_loadbalancer_invoker_anomaly_score"] == "gauge"
+        score_series = [ln for ln in text.splitlines() if ln.startswith(
+            "openwhisk_loadbalancer_invoker_anomaly_score{")]
+        assert score_series and all('signal="' in ln for ln in score_series)
+        assert types["openwhisk_alerts_firing"] == "gauge"
+        assert ('openwhisk_alerts_firing{alertname="straggler",'
+                'severity="warning"} 1') in text
+        assert types["openwhisk_alert_transitions_total"] == "counter"
+        assert ('openwhisk_alert_transitions_total{alertname="straggler",'
+                'transition="firing"} 1') in text
+        # tracing health gauges (satellite: orphan finishes are visible)
+        assert types["openwhisk_tracing_orphan_finishes"] == "gauge"
+
+
+class TestOpenMetricsExemplars:
+    """Satellite: flight-recorder rows that carry a trace context leave a
+    `# {trace_id="..."}` exemplar on the matching phase-histogram bucket
+    line — but ONLY when the scrape negotiates OpenMetrics (the classic
+    text format has no exemplar syntax and its parsers reject one)."""
+
+    PORT = 13381
+
+    def test_exemplar_only_on_openmetrics_scrape(self):
+        from openwhisk_tpu.controller.core import Controller
+
+        trace_id = "ab" * 16
+
+        async def go():
+            from openwhisk_tpu.utils.logging import NullLogging
+            provider = MemoryMessagingProvider()
+            logger = NullLogging()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              logger=logger, metrics=logger.metrics,
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            controller = Controller(ControllerInstanceId("0"), provider,
+                                    logger=logger, load_balancer=bal)
+            ident = Identity.generate("guest")
+            await controller.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await controller.start(port=self.PORT)
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            try:
+                action = make_action("traced", memory=128)
+                msgs = [make_msg(action, ident, True) for _ in range(4)]
+                for m in msgs:
+                    m.trace_context = {
+                        "traceparent": f"00-{trace_id}-{'cd' * 8}-01"}
+                await asyncio.gather(*[await bal.publish(action, m)
+                                       for m in msgs])
+                await asyncio.sleep(0.2)
+                out = {}
+                async with aiohttp.ClientSession() as s:
+                    om_hdrs = {"Accept": "application/openmetrics-text; "
+                                         "version=1.0.0"}
+                    async with s.get(
+                            f"http://127.0.0.1:{self.PORT}/metrics",
+                            headers=om_hdrs) as r:
+                        out["om"] = (r.content_type, await r.text())
+                    async with s.get(
+                            f"http://127.0.0.1:{self.PORT}/metrics") as r:
+                        out["text"] = (r.content_type, await r.text())
+                return out
+            finally:
+                await controller.stop()
+                for inv in invokers:
+                    await inv.stop()
+
+        out = asyncio.run(go())
+        om_type, om_text = out["om"]
+        assert om_type == "application/openmetrics-text"
+        assert om_text.endswith("# EOF\n")
+        ex_lines = [ln for ln in om_text.splitlines()
+                    if f'# {{trace_id="{trace_id}"}}' in ln]
+        assert ex_lines, "no exemplar on the OpenMetrics page"
+        assert all(
+            ln.startswith(
+                "openwhisk_loadbalancer_phase_duration_seconds_bucket{")
+            for ln in ex_lines)
+        # OpenMetrics counter naming: the family is suffix-free, every
+        # sample carries `_total` — Prometheus's OM parser rejects the
+        # whole page otherwise, so exemplar scraping would lose all
+        # metrics instead of adding trace links.
+        om_counters = set()
+        for ln in om_text.splitlines():
+            m = re.match(r"^# TYPE (\S+) counter$", ln)
+            if m:
+                assert not m.group(1).endswith("_total"), \
+                    f"OM counter family keeps _total suffix: {m.group(1)}"
+                om_counters.add(m.group(1))
+        assert om_counters, "no counter families on the OM page"
+        sample_names = {m.group(1) for m in (
+            _SAMPLE.match(ln.split(" # {")[0])
+            for ln in om_text.splitlines()
+            if ln and not ln.startswith("#")) if m}
+        for fam in om_counters:
+            assert fam + "_total" in sample_names, \
+                f"OM counter {fam} has no _total sample"
+        # the classic page still types counters by their full sample name
+        txt_text = out["text"][1]
+        classic_counters = {
+            m.group(1) for m in (
+                re.match(r"^# TYPE (\S+) counter$", ln)
+                for ln in txt_text.splitlines()) if m}
+        assert any(c.endswith("_total") for c in classic_counters)
+        # exemplar format: `value # {labels} exemplar_value timestamp`
+        for ln in ex_lines:
+            suffix = ln.split("# {", 1)[1].split("} ", 1)[1]
+            ex_val, ex_ts = suffix.split(" ")
+            assert float(ex_val) > 0 and float(ex_ts) > 0
+        txt_type, txt_text = out["text"]
+        assert txt_type == "text/plain"
+        assert "# {" not in txt_text and "# EOF" not in txt_text
+        # the classic page still passes the full exposition grammar
+        validate_exposition(txt_text)
+
+
+class TestOpenMetricsCounterNaming:
+    """Unit twin of the live OM-page counter check: both render paths
+    (the family helpers and MetricEmitter's own counters) switch to
+    suffix-free family names + `_total` samples only when asked for
+    OpenMetrics, leaving the classic text format untouched."""
+
+    def test_counter_family_text_negotiates_total_suffix(self):
+        from openwhisk_tpu.controller.monitoring import counter_family_text
+        rows = [({"a": "b"}, 3)]
+        classic = counter_family_text("x_total", rows)
+        assert classic[0] == "# TYPE x_total counter"
+        assert classic[1] == 'x_total{a="b"} 3'
+        om = counter_family_text("x_total", rows, openmetrics=True)
+        assert om[0] == "# TYPE x counter"
+        assert om[1] == 'x_total{a="b"} 3'
+        # a family named without the suffix gains it on the OM page only
+        om = counter_family_text("y", rows, openmetrics=True)
+        assert om[0] == "# TYPE y counter"
+        assert om[1] == 'y_total{a="b"} 3'
+
+    def test_metric_emitter_counters_openmetrics(self):
+        from openwhisk_tpu.utils.logging import MetricEmitter
+        m = MetricEmitter()
+        m.counter("completions_total", 2)
+        m.counter("bare", 1, tags={"k": "v"})
+        om = m.prometheus_text(openmetrics=True)
+        assert "# TYPE openwhisk_completions counter" in om
+        assert "openwhisk_completions_total 2" in om
+        assert "# TYPE openwhisk_bare counter" in om
+        assert 'openwhisk_bare_total{k="v"} 1' in om
+        classic = m.prometheus_text()
+        assert "# TYPE openwhisk_completions_total counter" in classic
+        assert "openwhisk_completions_total 2" in classic
+        assert 'openwhisk_bare{k="v"} 1' in classic
+        assert "openwhisk_bare_total" not in classic

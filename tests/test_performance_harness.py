@@ -92,3 +92,41 @@ class TestWarmHitParity:
         assert out["decision_parity"] == 1.0
         assert out["kernel_warm_rate"] == out["oracle_warm_rate"]
         assert out["kernel_warm_rate"] > 0.5  # the workload produces warm hits
+
+
+class TestBenchRiderBackendFallback:
+    """Satellite: a backend that dies LAZILY at the first dispatched op
+    (past bench.py's subprocess probe) must not kill the rider — it re-runs
+    under JAX_PLATFORMS=cpu and tags the JSON `"backend": "cpu_fallback"`."""
+
+    def test_backend_unavailable_classifier(self):
+        import bench
+        assert bench._backend_unavailable(RuntimeError(
+            "Unable to initialize backend 'axon': UNAVAILABLE: TPU backend "
+            "setup/compile error (Unavailable)."))
+        assert not bench._backend_unavailable(RuntimeError("boom"))
+        assert not bench._backend_unavailable(
+            ValueError("Unable to initialize backend"))
+
+    def test_run_rider_tags_cpu_fallback(self, monkeypatch):
+        import bench
+        monkeypatch.setattr(bench, "_rider_subprocess_cpu",
+                            lambda name: {"overhead_pct": 1.2})
+
+        def dead_rider():
+            raise RuntimeError("Unable to initialize backend 'axon': "
+                               "UNAVAILABLE")
+
+        out = bench._run_rider("_dead_rider", dead_rider)
+        assert out == {"overhead_pct": 1.2, "backend": "cpu_fallback"}
+
+    def test_run_rider_passes_healthy_result_through(self):
+        import bench
+        assert bench._run_rider("_ok", lambda: {"overhead_pct": 0.4}) == \
+            {"overhead_pct": 0.4}
+
+    def test_run_rider_reraises_other_errors(self):
+        import bench
+        with pytest.raises(RuntimeError, match="boom"):
+            bench._run_rider("_x", lambda: (_ for _ in ()).throw(
+                RuntimeError("boom")))

@@ -178,3 +178,45 @@ class TestConfigGate:
         assert reporter.url == "http://zipkin:9411/api/v2/spans"
         assert reporter.batch_size == 7
         assert reporter.service_name == "invoker-a"
+
+
+class TestOrphanFinishes:
+    """Satellite: finish_span on a missing/foreign span used to silently
+    return None — now it counts, and the tracing gauges expose it."""
+
+    def test_orphan_finish_counts_and_gauges(self):
+        from openwhisk_tpu.utils.logging import MetricEmitter
+        from openwhisk_tpu.utils.tracing import (Span, export_tracing_gauges)
+        import time as _time
+
+        t = Tracer()
+        tid = TransactionId()
+        # no stack at all for this transid
+        assert t.finish_span(tid) is None
+        assert t.orphan_finishes == 1
+        # a span that is not in the stack (e.g. finished twice)
+        live = t.start_span("op", tid)
+        foreign = Span("t" * 32, "f" * 16, None, "ghost", _time.time())
+        assert t.finish_span(tid, span=foreign) is None
+        assert t.orphan_finishes == 2
+        # a legitimate finish does not count
+        assert t.finish_span(tid, span=live) is live
+        assert t.orphan_finishes == 2
+        # double-finish of the same span IS an orphan again
+        assert t.finish_span(tid, span=live) is None
+        assert t.orphan_finishes == 3
+
+        m = MetricEmitter()
+        export_tracing_gauges(m, t)
+        assert m.gauge_value("tracing_orphan_finishes") == 3
+        assert m.gauge_value("tracing_spans_sent") == 1
+        assert m.gauge_value("tracing_spans_dropped") == 0
+        assert m.gauge_value("tracing_active_transactions") == 0
+
+    def test_trace_id_of_parses_traceparent(self):
+        from openwhisk_tpu.utils.tracing import trace_id_of
+        assert trace_id_of({"traceparent": f"00-{'ab' * 16}-{'cd' * 8}-01"}) \
+            == "ab" * 16
+        assert trace_id_of(None) is None
+        assert trace_id_of({}) is None
+        assert trace_id_of({"traceparent": "garbage"}) is None
