@@ -35,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import time
 import zlib
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import jax
@@ -48,15 +49,49 @@ from ...models.sharding_policy import (MIN_SLOT_MB, generate_hash,
 from ...ops.placement import (PlacementState, RequestBatch, init_state,
                               make_fused_admit_step_packed,
                               make_fused_step_packed, make_release_packed,
-                              release_batch, schedule_batch, set_health,
-                              unpack_chosen)
+                              release_batch, release_batch_vector,
+                              schedule_batch, schedule_batch_repair,
+                              set_health, unpack_chosen, unpack_step_output)
 from ...ops.throttle import init_buckets
+from ...utils.config import load_config
+from ...utils.ring_buffer import ColumnRing
 from ...utils.tracing import export_tracing_gauges, trace_id_of
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException, LoadBalancerThrottleException)
 from .flight_recorder import (BatchRecord, free_slot_histogram,
                               occupancy_json)
 from .supervision import InvokerPool
+
+
+@dataclass(frozen=True)
+class PlacementPathConfig:
+    """`CONFIG_whisk_loadBalancer_*` hot-path knobs (constructor arguments
+    override the env).
+
+    placement_kernel: which BATCH ALGORITHM schedules a micro-batch on the
+      XLA path — "scan" (the reference lax.scan: sequential depth B, the
+      bit-exact legacy path), "repair" (speculate-and-repair: sequential
+      depth ~ the intra-batch conflict count; bit-exact with the scan, see
+      ops/placement.schedule_batch_repair), or "auto" (repair on the XLA
+      path; the pallas and sharded schedules keep their own kernels).
+      Orthogonal to the `kernel` knob (xla/pallas device implementation).
+    donate_state: donate the PlacementState (and token-bucket carry) to the
+      fused step via donate_argnums, so the [N, A] concurrency matrix stops
+      round-tripping through fresh HBM allocations every step. Holders of
+      the pre-call state must copy first (see _materialize_state).
+    ring_assembly: assemble the packed request/release matrices from
+      preallocated int32 column rings written at enqueue time (O(1) per
+      activation) instead of per-flush list-of-tuples np.array transposes.
+    prewarm: compile successor bucket signatures ahead of traffic on a
+      background drainer thread (see _prewarm_buckets). Off = every new
+      bucket shape compiles synchronously inside a live dispatch — the
+      legacy behavior, also the right setting for latency-measurement
+      harnesses that can't tolerate background-compile GIL hiccups.
+    """
+    placement_kernel: str = "auto"   # scan | repair | auto
+    donate_state: bool = True
+    ring_assembly: bool = True
+    prewarm: bool = True
 
 
 def _next_pow2(n: int) -> int:
@@ -184,11 +219,33 @@ class TpuBalancer(CommonLoadBalancer):
                  initial_pad: int = 64, mesh=None, kernel: str = "auto",
                  pipeline_depth: int = 4,
                  rate_limit_per_minute: Optional[int] = None,
+                 placement_kernel: Optional[str] = None,
+                 donate_state: Optional[bool] = None,
+                 ring_assembly: Optional[bool] = None,
+                 prewarm: Optional[bool] = None,
                  profiler=None, anomaly=None):
         super().__init__(messaging_provider, controller_instance, logger,
                          metrics, profiler=profiler, anomaly=anomaly)
         self._cluster_size = cluster_size
         self.kernel = kernel  # "auto" | "xla" | "pallas" (single-device)
+        path_cfg = load_config(PlacementPathConfig, env_path="load_balancer")
+        #: scan | repair | auto — the batch algorithm on the XLA path
+        self.placement_kernel = (placement_kernel if placement_kernel
+                                 is not None else path_cfg.placement_kernel)
+        if self.placement_kernel not in ("scan", "repair", "auto"):
+            raise ValueError(
+                f"placement_kernel must be scan|repair|auto, "
+                f"got {self.placement_kernel!r}")
+        self.donate_state = (donate_state if donate_state is not None
+                             else path_cfg.donate_state)
+        #: explicit constructor True pins donation even where the backend
+        #: auto-gate would drop it (tests exercising materialize
+        #: boundaries on the CPU twin)
+        self._donate_pinned = donate_state is True
+        self.ring_assembly = (ring_assembly if ring_assembly is not None
+                              else path_cfg.ring_assembly)
+        self.prewarm = (prewarm if prewarm is not None
+                        else path_cfg.prewarm)
         self.managed_fraction = managed_fraction
         self.blackbox_fraction = blackbox_fraction
         self.batch_window = batch_window
@@ -216,11 +273,24 @@ class TpuBalancer(CommonLoadBalancer):
         self.state: Optional[PlacementState] = None
         self._sched_fn = None
         self._release_fn = None
+        #: host numpy copy of free_mb from the last readback/state install —
+        #: occupancy() serves from this, never the live device buffer.
+        #: Installs are sequence-guarded: readback worker threads finish
+        #: out of order under the pipeline, and last-writer-wins would let
+        #: an older step's books stick until the next dispatch.
+        self._books_cache: Optional[np.ndarray] = None
+        self._books_seq = 0
+        self._books_cache_seq = 0
         self._init_device_state()
 
-        # pending request queue + delta buffers
+        # pending request queue + delta buffers; with ring_assembly the int
+        # fields mirror into preallocated column rings at enqueue time so
+        # the per-flush packed matrices assemble with two slice copies
+        # instead of a list-of-tuples np.array transpose
         self._pending: List[tuple] = []      # (req_tuple, future, slot_key)
         self._releases: List[tuple] = []     # (inv_idx, slot, mem, maxc, key)
+        self._req_ring = ColumnRing(10, max_batch * 4)
+        self._rel_ring = ColumnRing(4, max_batch * 4)
         self._health_updates: Dict[int, bool] = {}
         self._flush_task: Optional[asyncio.Task] = None
         self._step_lock = asyncio.Lock()
@@ -290,6 +360,10 @@ class TpuBalancer(CommonLoadBalancer):
         state = state._replace(health=health)
         self.kernel_resolved = (
             "sharded" if self.mesh is not None else self._resolve_kernel())
+        if self.placement_kernel == "repair" and self.mesh is None:
+            # explicit repair pins the XLA path: the pallas schedule has no
+            # repair loop (its VMEM-tiled scan IS its speedup)
+            self.kernel_resolved = "xla"
         if self.mesh is not None:
             from ...parallel.sharded_state import (make_sharded_release,
                                                    make_sharded_schedule,
@@ -297,6 +371,11 @@ class TpuBalancer(CommonLoadBalancer):
             self.state = shard_state(state, self.mesh)
             self._sched_fn = make_sharded_schedule(self.mesh)
             self._release_fn = make_sharded_release(self.mesh)
+            self.placement_kernel_resolved = "scan"
+            if self.placement_kernel == "repair" and self.logger:
+                self.logger.warn(
+                    None, "placement_kernel=repair has no sharded variant; "
+                    "the mesh schedule keeps its scan kernel")
         elif self.kernel_resolved == "pallas" and self._pallas_fits():
             from ...ops.placement_pallas import (schedule_batch_pallas,
                                                  to_transposed)
@@ -315,10 +394,10 @@ class TpuBalancer(CommonLoadBalancer):
             self.state = state
             self._sched_fn = sched
             self._release_fn = release_batch
+            self.placement_kernel_resolved = "scan"
         else:
             self.state = state
-            self._sched_fn = schedule_batch
-            self._release_fn = release_batch
+            self._sched_fn, self._release_fn = self._xla_fns()
             if self.kernel_resolved == "pallas":
                 # explicit kernel="pallas" that failed the VMEM fit:
                 # report what actually runs
@@ -327,6 +406,64 @@ class TpuBalancer(CommonLoadBalancer):
         # three dispatches per micro-batch), fed through the transfer-packed
         # wrappers (3 host->device transfers per step instead of 16)
         self._build_packed_fns()
+        self._set_books_now(np.asarray(self.state.free_mb))
+
+    #: batch-bucket width from which "auto" swaps the scan program for the
+    #: speculate-and-repair kernel. Below it the scan both EXECUTES fine
+    #: (a handful of sequential probe steps) and COMPILES ~3x faster
+    #: (~0.45 s vs ~1.2 s per bucket signature on a dev box) — and compile
+    #: latency is what light traffic actually feels, since a new bucket
+    #: shape jit-compiles inside a live dispatch. At and above it the
+    #: scan's B-length dependency chain dominates and repair wins outright.
+    REPAIR_MIN_BATCH = 32
+    #: on the CPU twin the repair program's per-round vector work (a full
+    #: [B, N] re-speculation plus [A]-wide conflict scatters) is real
+    #: compute, not free dispatch slack — below this fleet padding the
+    #: scan's short dependency chain is cheaper than one repair round
+    #: (measured ~4x at N=64, B<=64), so "auto" additionally requires
+    #: fleet >= this on CPU. Irrelevant on devices, where both programs
+    #: are dispatch-bound at these shapes.
+    REPAIR_MIN_FLEET_CPU = 256
+
+    def _xla_fns(self):
+        """(schedule_fn, release_fn) for the XLA path, honoring the
+        placement-kernel knob. "repair" pins the speculate-and-repair
+        schedule + vectorized release fold at every size; "scan" keeps the
+        reference lax.scan pair (the true-no-op legacy path); "auto" picks
+        PER BUCKET — batch/release widths are static per jit signature, so
+        the branch resolves at trace time and each compiled program
+        contains exactly one kernel: scan below REPAIR_MIN_BATCH, repair
+        at and above it. All pairs are bit-exact (the fuzz suite asserts
+        it), so the knob only moves compile/run cost, never placements."""
+        if self.placement_kernel == "repair":
+            self.placement_kernel_resolved = "repair"
+            return schedule_batch_repair, release_batch_vector
+        if self.placement_kernel == "auto":
+            self.placement_kernel_resolved = "repair"
+            threshold = self.REPAIR_MIN_BATCH
+            min_fleet = (self.REPAIR_MIN_FLEET_CPU
+                         if jax.default_backend() == "cpu" else 0)
+
+            def auto_schedule(state, batch):
+                # both shapes are static at trace time
+                if (batch.valid.shape[0] >= threshold
+                        and state.free_mb.shape[0] >= min_fleet):
+                    return schedule_batch_repair(state, batch)
+                return schedule_batch(state, batch)
+
+            def auto_release(state, inv, slot, need_mb, max_conc, valid):
+                if (inv.shape[0] >= threshold
+                        and state.free_mb.shape[0] >= min_fleet):
+                    return release_batch_vector(state, inv, slot, need_mb,
+                                                max_conc, valid)
+                return release_batch(state, inv, slot, need_mb, max_conc,
+                                     valid)
+
+            auto_schedule._placement_hybrid = True
+            auto_release._placement_hybrid = True
+            return auto_schedule, auto_release
+        self.placement_kernel_resolved = "scan"
+        return schedule_batch, release_batch
 
     def _build_packed_fns(self) -> None:
         # the profiler interposes on every jitted entry point: compile
@@ -334,11 +471,24 @@ class TpuBalancer(CommonLoadBalancer):
         # statics (the only shapes _bucket may produce) — anything else is
         # shape churn and trips the recompile watchdog
         from ...ops.profiler import pow2_statics
+        # buffer donation: XLA reuses the state's buffers for the output, so
+        # the [N, A] concurrency matrix stops round-tripping HBM every step.
+        # Off on a mesh (sharded buffers stay owned by their own path) and
+        # on the CPU backend: XLA:CPU cannot alias donated buffers and runs
+        # the donated program SYNCHRONOUSLY at dispatch — the event loop
+        # blocks for the whole step, the RTT EWMA reads ~0 and flips the
+        # dispatch regime to eager micro-batches (measured 5x rate loss on
+        # the CPU twin) — all cost, no HBM to save. An explicit
+        # donate_state=True constructor argument pins it on anyway.
+        self._donate = (self.donate_state and self.mesh is None
+                        and (jax.default_backend() != "cpu"
+                             or self._donate_pinned))
         if self.rate_limit_per_minute is not None:
             self._packed_fn = self.profiler.wrap(
                 "fused_admit_step",
                 make_fused_admit_step_packed(self._release_fn,
-                                             self._sched_fn),
+                                             self._sched_fn,
+                                             donate=self._donate),
                 expected=pow2_statics)
             # bucket state is SOFT (a rolling rate window, never
             # checkpointed) but it CARRIES across kernel swaps and growth
@@ -350,11 +500,101 @@ class TpuBalancer(CommonLoadBalancer):
         else:
             self._packed_fn = self.profiler.wrap(
                 "fused_step",
-                make_fused_step_packed(self._release_fn, self._sched_fn),
+                make_fused_step_packed(self._release_fn, self._sched_fn,
+                                       donate=self._donate),
                 expected=pow2_statics)
         self._release_packed_fn = self.profiler.wrap(
-            "release_packed", make_release_packed(self._release_fn),
+            "release_packed",
+            make_release_packed(self._release_fn, donate=self._donate),
             expected=lambda st, rel: _next_pow2(rel.shape[1]) == rel.shape[1])
+        # fn rebuild = fresh jit caches: everything needs re-warming (the
+        # queue entries pin the fn they were enqueued for, so stale warms
+        # drain harmlessly against the abandoned cache)
+        self._warm_sigs = set()
+        self._warm_queue = []
+        self._warm_task = getattr(self, "_warm_task", None)
+
+    def _prewarm_buckets(self, r: int, h: int, b: int) -> None:
+        """Compile-ahead for the packed step's SUCCESSOR bucket shapes. A
+        new (R, H, B) signature otherwise compiles synchronously inside a
+        live dispatch — ~0.5 s for the scan program and ~1.2 s for the
+        repair kernel on a dev box — stalling the event loop and inflating
+        the e2e latency of every in-flight activation. XLA compiles
+        release the GIL, so warming on a worker thread costs the loop only
+        millisecond hiccups while the jit cache fills for the real call.
+        Buckets grow by doubling, so (2R, H, B) and (R, H, 2B) keep the
+        compiled set one step ahead of traffic growth; already-warmed
+        signatures de-dup in _warm_sigs (reset when the fns rebuild).
+        Skipped on a mesh: sharded inputs would key a different cache.
+        `prewarm=False` disables the whole plane (legacy compile-on-demand
+        behavior)."""
+        if self.mesh is not None or not self.prewarm:
+            return
+        self._warm_sigs.add((r, h, b))  # the live call just compiled it
+        cand = []
+        if r < self.max_batch * 4:
+            cand.append((min(r * 2, self.max_batch * 4), h, b))
+        if b < self.max_batch:
+            cand.append((r, h, min(b * 2, self.max_batch)))
+        self._spawn_warm([s for s in cand if s not in self._warm_sigs])
+
+    def _spawn_warm(self, todo: list) -> None:
+        """Queue signatures for the single warm drainer. ONE compile runs
+        at a time: concurrent warm compiles multiply the GIL hiccups the
+        event loop feels, without finishing the ladder any sooner."""
+        if not todo or getattr(self, "_closing", False):
+            return
+        self._warm_sigs.update(todo)
+        self._warm_queue.extend((sig, self._packed_fn) for sig in todo)
+        if self._warm_task is not None and not self._warm_task.done():
+            return
+
+        async def _drain():
+            while self._warm_queue and not getattr(self, "_closing", False):
+                sig, fn = self._warm_queue.pop(0)
+                await asyncio.to_thread(self._warm_one, sig, fn)
+
+        self._warm_task = asyncio.get_event_loop().create_task(_drain())
+        self._readbacks.add(self._warm_task)
+        self._warm_task.add_done_callback(self._readbacks.discard)
+
+    def _warm_one(self, sig: tuple, fn) -> None:
+        wr, wh, wb = sig
+        rate_on = self.rate_limit_per_minute is not None
+        rows = 10 if rate_on else 9
+        buf = jnp.asarray(np.zeros(5 * wr + 3 * wh + rows * wb, np.int32))
+
+        # all-zero dummies: valid masks are 0, so nothing places or
+        # releases — only the compile (keyed on shapes + statics) matters.
+        # Donation consumes the dummies, nothing else; each warmed entry
+        # point gets its own.
+        def dummy_state():
+            return PlacementState(
+                jnp.zeros((self._n_pad,), jnp.int32),
+                jnp.zeros((self._n_pad, self.action_slots), jnp.int32),
+                jnp.zeros((self._n_pad,), bool))
+
+        try:
+            if rate_on:
+                buckets = init_buckets(self.RATE_NS_BUCKETS,
+                                       self.rate_limit_per_minute)
+                fn((dummy_state(), buckets), buf,
+                   np.float32(time.monotonic() - self._t0_mono), wr, wh, wb)
+            else:
+                fn(dummy_state(), buf, wr, wh, wb)
+            # the idle release fold compiles its own release-only program
+            # per R bucket — warm it too, or a drain-only lull still eats
+            # the in-dispatch compile stall this plane exists to avoid
+            self._release_packed_fn(dummy_state(),
+                                    np.zeros((5, wr), np.int32))
+        except Exception as e:  # noqa: BLE001 — warming is best-effort;
+            # the live path compiles on demand anyway. But a SILENT fail
+            # would make a systematically broken prewarm (dummy inputs
+            # drifting from the real signature) look identical to a
+            # working one, so say why.
+            if self.logger:
+                self.logger.warn(None, f"bucket prewarm {sig} failed: {e!r}",
+                                 "TpuBalancer")
 
     def _ns_slot(self, ns_id: str) -> int:
         slot = self._ns_slots.get(ns_id)
@@ -378,8 +618,7 @@ class TpuBalancer(CommonLoadBalancer):
         the VMEM budget, via growth or snapshot restore)."""
         self.profiler.expect("kernel_swap")
         self.kernel_resolved = "xla"
-        self._sched_fn = schedule_batch
-        self._release_fn = release_batch
+        self._sched_fn, self._release_fn = self._xla_fns()
         self._build_packed_fns()
 
     def _pallas_fits(self) -> bool:
@@ -417,16 +656,103 @@ class TpuBalancer(CommonLoadBalancer):
                  for i in new_rows], jnp.int32)
             self.state = self.state._replace(
                 free_mb=self.state.free_mb.at[jnp.asarray(new_rows)].set(slot_vals))
+            # occupancy's cached books must learn the fresh rows' capacity
+            # (registration is rare; the sync transfer is n_pad int32s)
+            self._set_books_now(np.asarray(self.state.free_mb))
         self._health_updates[idx] = self._healthy[idx]
         self._recompute_partitions()
+
+    def _next_books_seq(self) -> int:
+        """Claim the next books-cache sequence number (event-loop only:
+        dispatches and state installs are loop-serialized)."""
+        self._books_seq += 1
+        return self._books_seq
+
+    def _install_books(self, books_np, seq: int) -> None:
+        """Install host books into occupancy()'s cache unless a NEWER
+        step's books already landed. Called on the event loop."""
+        if seq >= self._books_cache_seq:
+            self._books_cache_seq = seq
+            self._books_cache = books_np
+
+    def _set_books_now(self, books_np) -> None:
+        """Synchronous cache install for authoritative state changes
+        (init/registration/growth/restore) — supersedes any in-flight
+        readback's books."""
+        self._install_books(books_np, self._next_books_seq())
+
+    def _recover_consumed_state(self) -> bool:
+        """After a failed donated device call: if the failure happened
+        past the point where XLA consumed the donated buffers, the books
+        (and possibly the token-bucket carry, donated in the same tuple by
+        the admit variant) are unrecoverable deleted arrays — every later
+        call on them would die on 'Array has been deleted'. Rebuild
+        fresh-capacity state; leaked in-flight holds self-heal via forced
+        timeouts, exactly as after a restart. Returns True when a rebuild
+        happened (the failure consumed the donation), False when the
+        buffers are intact (failure before consumption, or donation off).
+        Every donated call site — request dispatch, the idle release
+        fold, the readback-compensation release — routes its failure
+        handler through here."""
+        if not self._donate:
+            return False
+        bucket_gone = (self._bucket_state is not None
+                       and self._bucket_state.tokens.is_deleted())
+        # check conc_free AND free_mb: on the CPU twin np.asarray is a
+        # zero-copy view, so the books cache PINS free_mb from donation
+        # (it survives undeleted) while the unreferenced conc_free/health
+        # buffers are consumed — free_mb alone would miss the outage
+        if not (self.state.free_mb.is_deleted()
+                or self.state.conc_free.is_deleted() or bucket_gone):
+            return False
+        if self.logger:
+            self.logger.error(
+                None, "device call failure consumed the donated state;"
+                " rebuilding device books", "TpuBalancer")
+        if bucket_gone:
+            self._bucket_state = None
+        self._init_device_state()
+        return True
+
+    def _books_ref(self):
+        """Donation-safe reference to the post-step books vector, taken on
+        the event loop BEFORE any later dispatch can consume the live
+        buffers: under donation the next dispatched step invalidates
+        self.state, so holders crossing an await/thread boundary get their
+        own device-side copy (n_pad int32s — never the [N, A] matrix)."""
+        return (jnp.copy(self.state.free_mb) if self._donate
+                else self.state.free_mb)
+
+    def _set_inflight(self, delta: int) -> None:
+        """Single writer for the in-flight step counter and its gauge —
+        the two must never drift, so every pipeline transition (dispatch,
+        readback, both failure paths) goes through here."""
+        self._inflight_steps += delta
+        self.metrics.gauge("loadbalancer_pipeline_inflight",
+                           self._inflight_steps)
+
+    def _materialize_state(self) -> PlacementState:
+        """Copy-out boundary for holders of the device state. With buffer
+        donation ON, the NEXT dispatched step CONSUMES self.state's buffers
+        (XLA aliases them into its output), so any reader that keeps the
+        state across an await/thread boundary — the snapshot worker, a
+        growth re-pad racing the pipeline, occupancy's cold fallback — must
+        hold its own copy. Without donation the arrays are immutable and
+        the live reference is safe to hold forever."""
+        st = self.state
+        if not getattr(self, "_donate", False):
+            return st
+        return PlacementState(jnp.copy(st.free_mb), jnp.copy(st.conc_free),
+                              jnp.copy(st.health))
 
     def _grow_padding(self, new_pad: int) -> None:
         """Re-pad the device arrays, PRESERVING the live books (in-flight
         memory holds and concurrency permits survive fleet growth; only
         update_cluster resets them, which is reference behavior)."""
-        old_free = np.asarray(self.state.free_mb)
-        old_conc = np.asarray(self.state.conc_free)
-        old_health = np.asarray(self.state.health)
+        st = self._materialize_state()
+        old_free = np.asarray(st.free_mb)
+        old_conc = np.asarray(st.conc_free)
+        old_health = np.asarray(st.health)
         self.profiler.expect("fleet_growth")
         n_old = old_free.shape[0]
         free = np.zeros((new_pad,), np.int32)
@@ -466,6 +792,7 @@ class TpuBalancer(CommonLoadBalancer):
             from ...parallel.sharded_state import shard_state
             state = shard_state(state, self.mesh)
         self.state = state
+        self._set_books_now(np.asarray(state.free_mb))
         if (getattr(self, "kernel_resolved", self.kernel) == "pallas"
                 and not self._pallas_fits()):
             self._use_xla_kernels()
@@ -473,14 +800,15 @@ class TpuBalancer(CommonLoadBalancer):
     def _grow_slots(self, new_slots: int) -> None:
         """Widen conc_free's action axis, preserving every live permit."""
         self.profiler.expect("slot_growth")
-        old_conc = np.asarray(self.state.conc_free)
+        st = self._materialize_state()
+        old_conc = np.asarray(st.conc_free)
         conc = np.zeros((old_conc.shape[0], new_slots), np.int32)
         conc[:, : old_conc.shape[1]] = old_conc
         self.action_slots = new_slots
         self._slots.grow(new_slots)
-        self._install_state(PlacementState(self.state.free_mb,
+        self._install_state(PlacementState(st.free_mb,
                                            jnp.asarray(conc),
-                                           self.state.health))
+                                           st.health))
         self.metrics.counter("loadbalancer_action_slot_growth")
         if self.logger:
             self.logger.info(
@@ -516,6 +844,11 @@ class TpuBalancer(CommonLoadBalancer):
     async def start(self) -> None:
         self.start_ack_feed()
         self.supervision.start()
+        # warm the first-traffic bucket signature while the fleet is still
+        # registering, so the opening micro-batches skip the cold compile
+        if self.mesh is None and self.prewarm and \
+                (8, self.HEALTH_BATCH, 8) not in self._warm_sigs:
+            self._spawn_warm([(8, self.HEALTH_BATCH, 8)])
 
     async def close(self) -> None:
         self._closing = True  # no new flush tasks from here on
@@ -528,6 +861,7 @@ class TpuBalancer(CommonLoadBalancer):
                                  return_exceptions=True)
         # fail queued publishers instead of leaving them awaiting forever
         pending, self._pending = self._pending, []
+        self._req_ring.clear()
         for req, fut, slot_key, *_ in pending:
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
             if not fut.done():
@@ -537,6 +871,7 @@ class TpuBalancer(CommonLoadBalancer):
         for r in self._releases:
             self._slots.release(r[4], r[1])
         self._releases.clear()
+        self._rel_ring.clear()
         await super().close()
 
     # -- publish -----------------------------------------------------------
@@ -572,9 +907,17 @@ class TpuBalancer(CommonLoadBalancer):
         # trailing fields feed the flight recorder: enqueue time (queue-age
         # digest), the activation/action ids for the decision row, and the
         # trace id (exemplar plumbing on OpenMetrics scrapes)
-        self._pending.append((req, fut, slot_key, time.monotonic(),
-                              msg.activation_id.asString, fqn_str,
-                              trace_id_of(msg.trace_context)))
+        entry = (req, fut, slot_key, time.monotonic(),
+                 msg.activation_id.asString, fqn_str,
+                 trace_id_of(msg.trace_context))
+        if self.ring_assembly:
+            # the packed-matrix column lands in the preallocated ring NOW
+            # (one C-speed write) — flush-time assembly is two slice
+            # copies. The entry is built FIRST: an exception between a
+            # ring push and its queue append would desync the two FIFOs
+            # and shift every later request's geometry.
+            self._req_ring.push(req)
+        self._pending.append(entry)
         # inline fast path: with free pipeline capacity, dispatch NOW
         # (synchronously — the assembly+enqueue body has no awaits) when the
         # batch is full, or on an idle FAST device (sub-window round trips:
@@ -624,11 +967,21 @@ class TpuBalancer(CommonLoadBalancer):
         time, keeping the slot index pinned to this action until the
         device-side decrement lands."""
         if inv_idx >= 0:
-            self._releases.append((inv_idx, req[self.R_CONC_SLOT], req[self.R_NEED_MB],
-                                   req[self.R_MAX_CONC], slot_key))
+            self._queue_release(inv_idx, req[self.R_CONC_SLOT],
+                                req[self.R_NEED_MB], req[self.R_MAX_CONC],
+                                slot_key)
             self._arm_flush()
         else:
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
+
+    def _queue_release(self, inv: int, slot: int, mem: int, maxc: int,
+                       key: str) -> None:
+        """Buffer one capacity release for the next device step (the slot
+        KEY rides host-side for drain-time slot bookkeeping; the int column
+        mirrors into the release ring for flush assembly)."""
+        if self.ring_assembly:
+            self._rel_ring.push((inv, slot, mem, maxc))
+        self._releases.append((inv, slot, mem, maxc, key))
 
     # -- completion hooks --------------------------------------------------
     def release_invoker(self, invoker: InvokerInstanceId, entry) -> None:
@@ -636,8 +989,8 @@ class TpuBalancer(CommonLoadBalancer):
         key = f"{action_name}:{entry.memory_mb}"
         slot = (entry.conc_slot if entry.conc_slot is not None
                 else self._slots.lookup(key))
-        self._releases.append((invoker.instance, slot, entry.memory_mb,
-                               entry.max_concurrent, key))
+        self._queue_release(invoker.instance, slot, entry.memory_mb,
+                            entry.max_concurrent, key)
         self._arm_flush()
 
     def on_invocation_finished(self, invoker, is_system_error, forced) -> None:
@@ -646,18 +999,25 @@ class TpuBalancer(CommonLoadBalancer):
     async def invoker_health(self) -> List[InvokerHealth]:
         return self.supervision.health()
 
-    #: occupancy() forces a device->host sync — the admin endpoint runs it
-    #: on a worker thread so the event loop keeps serving mid-step
-    OCCUPANCY_SYNCS_DEVICE = True
+    #: occupancy() now serves from the last readback's CACHED books — no
+    #: device sync, so the admin endpoint runs inline on the event loop and
+    #: can never stall (or race a donated buffer under) the dispatch loop
+    OCCUPANCY_SYNCS_DEVICE = False
 
     def occupancy(self) -> dict:
-        """Per-invoker slots-in-use/capacity from the device books. Admin
-        cold path: the np.asarray forces one device->host transfer of the
-        free_mb vector, acceptable per introspection request. Runs on a
-        worker thread, so the host books are snapshotted up front (list()
-        is atomic under the GIL) and every index is length-guarded against
-        concurrent fleet growth on the event loop."""
-        free = np.asarray(self.state.free_mb)
+        """Per-invoker slots-in-use/capacity from the last device-step
+        readback's cached free_mb copy (refreshed on every readback and
+        every state install, so it exists from construction onward). Under
+        a full pipeline the cache lags the dispatched state by up to
+        `pipeline_depth` unread steps — and never costs a device->host
+        transfer on the API path, which under buffer donation would
+        additionally race the dispatch loop consuming the live buffer.
+        Host books are snapshotted up front (list() is atomic under the
+        GIL) and every index is length-guarded against concurrent fleet
+        growth."""
+        free = self._books_cache
+        if free is None:  # pre-init construction window: empty fleet
+            free = np.zeros((0,), np.int32)
         registry = list(self._registry)
         healthy = list(self._healthy)
         caps = self._caps_mb
@@ -685,9 +1045,12 @@ class TpuBalancer(CommonLoadBalancer):
         to the (immutable) device state plus copies of the host books. The
         heavy device->host transfer can then run on a worker thread
         (checkpoint.BalancerSnapshotter) without racing loop mutations or
-        mixing books from different device steps."""
+        mixing books from different device steps. With buffer donation ON
+        the captured state is an explicit device-side COPY: the live
+        reference would be consumed (invalidated) by the next pipelined
+        dispatch before the worker thread gets to read it."""
         return {
-            "state": self.state,
+            "state": self._materialize_state(),
             "n_pad": self._n_pad,
             "cluster_size": self._cluster_size,
             "action_slots": self.action_slots,
@@ -806,14 +1169,21 @@ class TpuBalancer(CommonLoadBalancer):
 
     def _release_packed(self) -> np.ndarray:
         """Drain buffered releases into ONE packed int32[5,R] host array
-        (+ host-side slot bookkeeping) — same padding as _release_arrays."""
+        (+ host-side slot bookkeeping) — same padding as _release_arrays.
+        With ring_assembly the int columns were written at enqueue time, so
+        assembly is two contiguous slice copies instead of a list-of-tuples
+        np.array transpose."""
         cap = self.max_batch * 4
         rel, self._releases = self._releases[:cap], self._releases[cap:]
         b = self._bucket(len(rel), cap) if rel else 8
         out = np.zeros((5, b), np.int32)
         out[3, len(rel):] = 1  # padded rows: maxc=1
         if rel:
-            out[:4, :len(rel)] = np.array([r[:4] for r in rel], np.int32).T
+            if self.ring_assembly:
+                self._rel_ring.pop_into(out[:4], len(rel))
+            else:
+                out[:4, :len(rel)] = np.array([r[:4] for r in rel],
+                                              np.int32).T
             out[4, :len(rel)] = 1
         for r in rel:
             self._slots.release(r[4], r[1])
@@ -843,7 +1213,7 @@ class TpuBalancer(CommonLoadBalancer):
         if (self._pending and not self._step_lock.locked()
                 and self._inflight_steps < self.pipeline_depth
                 and not getattr(self, "_closing", False)):
-            self._inflight_steps += 1
+            self._set_inflight(1)
             self._dispatch_batch()
             return True
         return False
@@ -852,13 +1222,30 @@ class TpuBalancer(CommonLoadBalancer):
         if not self._pending:
             # nothing to schedule: fold releases (padded+masked like the
             # fused path) and health (exact-size; dict keys are unique)
-            if self._releases:
-                self.state = self._release_packed_fn(self.state,
-                                                     self._release_packed())
-            if self._health_updates:
-                ups, self._health_updates = self._health_updates, {}
-                self.state = set_health(self.state, list(ups.keys()),
-                                        list(ups.values()))
+            folded = bool(self._releases)
+            try:
+                if self._releases:
+                    self.state = self._release_packed_fn(
+                        self.state, self._release_packed())
+                if self._health_updates:
+                    ups, self._health_updates = self._health_updates, {}
+                    self.state = set_health(self.state, list(ups.keys()),
+                                            list(ups.values()))
+            except Exception as e:  # noqa: BLE001 — a failed donated fold
+                # may have CONSUMED self.state: without a rebuild every
+                # later idle fold dies on the deleted buffer and a
+                # drain-only balancer stays wedged indefinitely. (The
+                # popped releases are moot either way: rebuilt books start
+                # at full capacity.)
+                if not self._recover_consumed_state():
+                    raise
+                if self.logger:
+                    self.logger.error(None, f"idle fold failed: {e!r}",
+                                      "TpuBalancer")
+            if folded:
+                # no schedule means no readback to piggyback the occupancy
+                # cache on — refresh it off-loop so idle fleets converge
+                self._refresh_books_async()
             try:
                 self.telemetry.device_fold()
             except Exception as e:  # noqa: BLE001 — a telemetry failure
@@ -875,7 +1262,7 @@ class TpuBalancer(CommonLoadBalancer):
         while self._inflight_steps >= self.pipeline_depth:
             self._capacity_free.clear()
             await self._capacity_free.wait()
-        self._inflight_steps += 1
+        self._set_inflight(1)
         self._dispatch_batch()
 
     def _dispatch_batch(self) -> None:
@@ -894,8 +1281,14 @@ class TpuBalancer(CommonLoadBalancer):
         req_np = np.zeros((rows, bp), np.int32)
         req_np[1, b:] = 1  # size
         req_np[6, b:] = 1  # max_conc
-        req_np[:, :b] = np.array(
-            [entry[0][:rows] for entry in batch], np.int32).T
+        if self.ring_assembly:
+            # columns were written at publish() time: drain the b oldest
+            # (rate off drops the ring's ns_slot row — pop_into copies only
+            # the rows req_np carries)
+            self._req_ring.pop_into(req_np, b)
+        else:
+            req_np[:, :b] = np.array(
+                [entry[0][:rows] for entry in batch], np.int32).T
         # flight-recorder input digest, captured host-side before the step
         # (batch is FIFO: batch[0] carries the oldest enqueue time)
         rec = None
@@ -935,8 +1328,9 @@ class TpuBalancer(CommonLoadBalancer):
             # leak the permit, the host-side conc slots, or strand the
             # publishers (device capacity from the drained releases is
             # recovered by forced-timeout self-heal)
-            self._inflight_steps -= 1
+            self._set_inflight(-1)
             self._capacity_free.set()
+            self._recover_consumed_state()
             for req, fut, slot_key, *_ in batch:
                 self._slots.release(slot_key, req[self.R_CONC_SLOT])
                 if not fut.done():
@@ -947,6 +1341,9 @@ class TpuBalancer(CommonLoadBalancer):
                                   "TpuBalancer")
             return
 
+        # compile-ahead: warm the successor bucket shapes off-loop before
+        # queue growth needs them in a live dispatch
+        self._prewarm_buckets(rel_np.shape[1], health_np.shape[1], bp)
         # completion telemetry rides the SAME dispatch cycle: at most one
         # extra scatter-add program per batch over event rows already packed
         # host-side — asynchronous like the step itself, no readback (counts
@@ -982,19 +1379,41 @@ class TpuBalancer(CommonLoadBalancer):
         # round-trip dwarfs the compute, and serializing them caps
         # throughput at batch/RTT. Dispatch stays event-loop-serialized
         # under the step lock; only readbacks overlap.
+        # under donation the NEXT dispatched step consumes self.state's
+        # buffers while this step's readback is still crossing the wire —
+        # _books_ref hands the worker thread its own device-side copy
+        books = self._books_ref()
         task = asyncio.get_event_loop().create_task(
-            self._readback_step(batch, b, out, t0, req_np, rec,
-                                self.state.free_mb))
+            self._readback_step(batch, b, out, t0, req_np, rec, books,
+                                self._next_books_seq()))
+        self._readbacks.add(task)
+        task.add_done_callback(self._readbacks.discard)
+
+    def _refresh_books_async(self) -> None:
+        """Refresh occupancy()'s cached books off a device step that has no
+        readback of its own (the idle release/health fold): take a
+        donation-safe reference to the books vector NOW, convert it on a
+        worker thread. Tracked in _readbacks so close() drains it."""
+        books = self._books_ref()
+        seq = self._next_books_seq()
+
+        async def _pull():
+            self._install_books(await asyncio.to_thread(np.asarray, books),
+                                seq)
+
+        task = asyncio.get_event_loop().create_task(_pull())
         self._readbacks.add(task)
         task.add_done_callback(self._readbacks.discard)
 
     def _read_back(self, out):
         """Device->host conversion seam (runs on the worker thread);
-        a separate method so tests can inject readback failures."""
-        return unpack_chosen(np.asarray(out))  # (chosen, forced, throttled)
+        a separate method so tests can inject readback failures. The packed
+        step returns B+1 elements: B decisions + the trailing repair-round
+        count (0 for scan/pallas/sharded kernels)."""
+        return unpack_step_output(np.asarray(out))
 
     async def _readback_step(self, batch, b, out, t0, req_np, rec=None,
-                             books_free=None) -> None:
+                             books_free=None, books_seq=0) -> None:
         # the step-duration stamp is taken ON the worker thread so the
         # metric measures device step + readback, not loop re-scheduling
         def _read():
@@ -1011,11 +1430,14 @@ class TpuBalancer(CommonLoadBalancer):
             # the balancer is in (not just infer it from latency shifts)
             self.metrics.gauge("loadbalancer_readback_rtt_ms",
                                self._rtt_ewma_ms)
+            # POST-step books captured at dispatch: the transfer happens
+            # here on the worker thread (tiny — n_pad int32s — and off the
+            # event loop); the copy also refreshes occupancy()'s cache so
+            # the admin endpoint never needs its own device sync — the
+            # install itself happens back on the loop, sequence-guarded
+            # (worker threads finish out of order under the pipeline)
+            free_np = np.asarray(books_free)
             if rec is not None:
-                # books digest off the POST-step free_mb captured at
-                # dispatch: the transfer happens here on the worker thread
-                # (tiny — n_pad int32s — and off the event loop)
-                free_np = np.asarray(books_free)
                 caps = self._caps_mb
                 n_reg = min(len(caps), len(free_np))
                 cap_total = int(caps[:n_reg].sum())
@@ -1025,11 +1447,12 @@ class TpuBalancer(CommonLoadBalancer):
                 rec.digest["occupancy"] = (
                     round(used / cap_total, 4) if cap_total else 0.0)
                 rec.timings["readback_ms"] = round(rb_ms, 3)
-            return arrs, t_r1
+            return arrs, t_r1, free_np
 
         try:
-            (chosen_np, forced_np, throttled_np), t_done = \
+            (chosen_np, forced_np, throttled_np, rounds), t_done, books_np = \
                 await asyncio.to_thread(_read)
+            self._install_books(books_np, books_seq)
         except Exception as e:  # noqa: BLE001 — publishers must not hang,
             # and their host-side conc slots must not leak. The DISPATCH
             # succeeded (only the host conversion failed), so the device
@@ -1039,7 +1462,7 @@ class TpuBalancer(CommonLoadBalancer):
             # the schedule fold acquired (release_batch is its inverse).
             compensated = True
             try:
-                chosen, _, _ = unpack_chosen(out)
+                chosen, _, _ = unpack_chosen(out[:-1])
                 rel = jnp.stack([
                     jnp.maximum(chosen, 0).astype(jnp.int32),
                     jnp.asarray(req_np[5]), jnp.asarray(req_np[4]),
@@ -1049,15 +1472,18 @@ class TpuBalancer(CommonLoadBalancer):
             except Exception:  # noqa: BLE001 — device genuinely dead: keep
                 # the host refcounts PINNED so the slot indices cannot be
                 # reassigned to a different action and inherit the phantom
-                # concurrency; restart/self-heal owns recovery from here
+                # concurrency; restart/self-heal owns recovery from here.
+                # If the failed release consumed the donated state, rebuild
+                # it so the dispatch loop itself survives the outage.
                 compensated = False
+                self._recover_consumed_state()
             for req, fut, slot_key, *_ in batch:
                 if compensated:
                     self._slots.release(slot_key, req[self.R_CONC_SLOT])
                 if not fut.done():
                     fut.set_exception(
                         LoadBalancerException(f"device step failed: {e}"))
-            self._inflight_steps -= 1
+            self._set_inflight(-1)
             self._capacity_free.set()
             # already surfaced through the futures — re-raising would only
             # produce unretrieved-task noise on the loop
@@ -1066,11 +1492,21 @@ class TpuBalancer(CommonLoadBalancer):
                                   f"(compensated={compensated})",
                                   "TpuBalancer")
             return
-        self._inflight_steps -= 1
+        self._set_inflight(-1)
         self._capacity_free.set()
         dt_ms = (t_done - t0) * 1e3
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)
+        if self.placement_kernel_resolved == "repair" and rounds > 0:
+            # how many speculate-commit rounds the batch actually cost —
+            # the knob's health signal (repair pays off iff this stays near
+            # 1; a fleet-sized spike means pathological intra-batch
+            # contention and the scan kernel would serve better). Batches
+            # the "auto" hybrid routed to the scan program report 0 and
+            # stay out of the histogram.
+            self.metrics.histogram("loadbalancer_repair_rounds", rounds)
+            if rec is not None:
+                rec.digest["repair_rounds"] = rounds
         t_f0 = time.monotonic()
         for (req, fut, slot_key, *_), inv_idx, f, thr in zip(
                 batch, chosen_np, forced_np, throttled_np):

@@ -16,6 +16,20 @@ which preserves the reference's sequential read-modify-write semantics
 exactly (intra-batch contention resolves identically to processing the
 requests one at a time).
 
+Two batch algorithms implement those semantics:
+
+  `schedule_batch`        — the reference scan: sequential depth B.
+  `schedule_batch_repair` — speculate-and-repair: round 1 probes ALL B
+                            requests against the pre-batch state at once,
+                            a prefix-conflict detector commits the
+                            conflict-free prefix-closure in one shot, and a
+                            `lax.while_loop` re-runs only the conflicting
+                            residue. Bit-exact with the scan (the fuzz
+                            suite asserts it); expected sequential depth
+                            collapses from B to the conflict count, which
+                            is small when fleet ≫ batch. See the conflict
+                            rules on `schedule_batch_repair`.
+
 State (static shapes; fleets grow into padding, SURVEY §7 risk list):
   free_mb   int32[N]     free memory permits per invoker (this controller's
                          shard; may go negative under forced placement, the
@@ -154,6 +168,228 @@ def schedule_batch(state: PlacementState, batch: RequestBatch
     return new_state, chosen, forced
 
 
+def _probe_geometry(n: int, batch: RequestBatch):
+    """The state-INDEPENDENT part of the batch probe, hoisted out of the
+    repair loop: partition masks, probe ranks and the forced-placement
+    choice (health never changes inside a batch — the fold runs before the
+    schedule — so the whole forced path is loop-invariant too... except
+    health, which the caller folds in). Returns [B, N] rank/in_part and the
+    per-request forced rotation key."""
+    big = jnp.int32(n + 2)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    local = idx[None, :] - batch.offset[:, None]          # [B, N]
+    size_col = batch.size[:, None]
+    in_part = (local >= 0) & (local < size_col)
+    size_safe = jnp.maximum(size_col, 1)
+    rank = _mulmod(local - batch.home[:, None], batch.step_inv[:, None],
+                   size_safe)
+    fkey_rot = jnp.mod(local - batch.rand[:, None], size_safe)
+    return big, in_part, rank, fkey_rot
+
+
+@jax.jit
+def schedule_batch_repair(state: PlacementState, batch: RequestBatch
+                          ) -> Tuple[PlacementState, jax.Array, jax.Array,
+                                     jax.Array]:
+    """Speculate-and-repair: bit-exact `schedule_batch` semantics with the
+    B-length sequential dependency chain collapsed to the conflict count.
+
+    Each round speculates every still-pending request against the current
+    state and commits the conflict-free prefix-closure in one scatter. A
+    pending request i (speculating invoker `sel`, probing conc column
+    `slot`) CONFLICTS — meaning an earlier pending request's commit could
+    change its decision — iff one of:
+
+      * an earlier pending NON-cascade writer chose the same invoker
+        (its commit touches sel's memory books or i's conc cell), or
+      * an earlier pending writer opens a shared container on i's conc
+        slot (`take_mem & max_conc > 1` adds permits anywhere in the
+        column, which can create a better-ranked eligible invoker — and
+        can even flip a would-be-forced request back to a normal
+        placement), or
+      * i takes memory (non-forced) at an invoker whose free space, after
+        the cumulative demand of earlier same-invoker memory-cascade
+        writers this round, no longer covers its need ("capacity made
+        insufficient by a committed prefix").
+
+    The memory cascade is the exactness refinement that keeps same-action
+    bursts parallel: `max_conc <= 1` memory writers touch ONLY
+    `free_mb[sel]`, so a run of them on one invoker commits together via
+    one accumulated scatter-add as long as the prefix demand still fits —
+    exactly the sequential outcome.
+
+    The commit set must respect sequential order: a conflicted request
+    re-speculates next round and may then write anywhere, so nothing after
+    it may blindly commit. Three classes are provably order-independent
+    and commit regardless of position:
+
+      * invalid rows and rows with no usable invoker (outcome invariant
+        under any writes), and
+      * non-forced placements i past the first conflict for which EVERY
+        earlier unresolved request j is a "pure memory" request
+        (`max_conc <= 1`, no consumable permit on its column, and no
+        pending container-opener on its column that could create one) AND
+        a pessimistic budget holds: `free_mb[sel_i]` covers the committing
+        cascade demand, the TOTAL demand of those unresolved requests
+        (wherever they eventually land — including all of them landing on
+        `sel_i`), and `need_i`. Under that budget no memory write in
+        either direction can flip an eligibility bit anyone reads, so
+        commits commute with the stragglers' later re-runs. Requests that
+        write a conc cell additionally require that no unresolved earlier
+        request probes the same column (conc writes never commute with
+        order-inverted column reads).
+
+    Everything else commits as a strict prefix up to the first conflict.
+    The head of the pending order never conflicts, so every round commits
+    at least one request and the loop terminates in at most B rounds
+    (rare; typically 1 + the depth of the worst per-invoker overflow
+    chain).
+
+    Returns (state, chosen, forced, rounds) — `rounds` is the repair-loop
+    trip count, exported by the balancer as the loadbalancer_repair_rounds
+    summary family.
+    """
+    b = batch.valid.shape[0]
+    bidx = jnp.arange(b, dtype=jnp.int32)
+    sentinel = jnp.int32(b)
+
+    # loop-invariant geometry: ranks, partitions, and the whole forced
+    # path (health is fixed inside a batch, and forced placement ignores
+    # capacity — `usable` never moves between repair rounds)
+    n = state.free_mb.shape[0]
+    a_slots = state.conc_free.shape[1]
+    big, in_part, rank, fkey_rot = _probe_geometry(n, batch)
+    usable = in_part & state.health[None, :]
+    fkey = jnp.where(usable, fkey_rot, big)
+    fchoice = jnp.argmin(fkey, axis=1).astype(jnp.int32)
+    have_usable = jnp.take_along_axis(fkey, fchoice[:, None], 1)[:, 0] < big
+    simple = batch.max_conc <= 1
+
+    def _first_index_where(flag, key, size):
+        """Per request i: does any FLAGGED request j < i share my `key`?
+        Scatter-min of flagged indices onto the key axis, then gather —
+        O(B + size) where the pairwise [B, B] formulation is O(B^2)."""
+        firsts = jnp.full((size,), sentinel).at[key].min(
+            jnp.where(flag, bidx, sentinel))
+        return firsts[key] < bidx
+
+    def _segment_exclusive_sum(values, key):
+        """Per request i: sum of `values[j]` over j < i with key_j ==
+        key_i. Stable sort by key keeps batch order inside each segment;
+        a cummax of the segment-start prefix turns the global cumsum into
+        per-segment exclusive sums."""
+        order = jnp.argsort(key, stable=True)
+        v_s = values[order]
+        k_s = key[order]
+        c = jnp.cumsum(v_s)
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), bool), k_s[1:] != k_s[:-1]])
+        base = jax.lax.cummax(jnp.where(seg_start, c - v_s, 0))
+        return jnp.zeros_like(c).at[order].set(c - v_s - base)
+
+    def cond(carry):
+        _, pending, _, _, rounds = carry
+        return jnp.any(pending) & (rounds <= b)
+
+    def body(carry):
+        state, pending, chosen, forced_acc, rounds = carry
+        # per-round speculation: only the capacity-dependent half of the
+        # probe re-runs (conc column gather + memory eligibility)
+        conc_bn = state.conc_free[:, batch.conc_slot].T   # [B, N]
+        has_conc = conc_bn > 0
+        eligible = usable & (has_conc
+                             | (state.free_mb[None, :]
+                                >= batch.need_mb[:, None]))
+        key = jnp.where(eligible, rank, big)
+        choice = jnp.argmin(key, axis=1).astype(jnp.int32)
+        found = jnp.take_along_axis(key, choice[:, None], 1)[:, 0] < big
+        sel = jnp.where(found, choice, fchoice)
+        placed = batch.valid & (found | have_usable)
+        forced = batch.valid & ~found & have_usable
+        conc_at_sel = jnp.take_along_axis(conc_bn, sel[:, None], 1)[:, 0]
+        use_conc = placed & (conc_at_sel > 0)
+        take_mem = placed & ~use_conc
+        # any consumable permit on my column inside my partition? (feeds
+        # the "pure memory request" predicate)
+        col_conc = jnp.any(usable & has_conc, axis=1)
+        writer = pending & placed
+        # memory-cascade writers: touch only free_mb[sel], no conc cell
+        cascade = writer & take_mem & simple
+        hard = writer & ~cascade
+        grow = writer & take_mem & ~simple
+
+        hard_conflict = (_first_index_where(hard, sel, n)
+                         | _first_index_where(grow, batch.conc_slot,
+                                              a_slots))
+        prior_mem = _segment_exclusive_sum(
+            jnp.where(cascade, batch.need_mb, 0), sel).astype(jnp.int32)
+        free_at_sel = state.free_mb[sel]
+        mem_conflict = (take_mem & ~forced
+                        & (free_at_sel - prior_mem < batch.need_mb))
+        conflict = pending & (hard_conflict | mem_conflict)
+        first_bad = jnp.min(jnp.where(conflict, bidx, jnp.int32(b)))
+
+        # out-of-order commits past the first conflict: i may commit while
+        # earlier requests stay unresolved iff every such straggler is a
+        # pure memory request, a pessimistic memory budget at sel_i covers
+        # all of them plus i, and i's conc write (if any) touches no column
+        # a straggler probes (see the docstring's order-independence
+        # argument). Conservative by construction: over-counting demand or
+        # purity only defers a commit to a later round, never mis-commits.
+        straggler = pending & placed & (bidx >= first_bad)
+        grow_potential = jnp.zeros((a_slots,), bool).at[batch.conc_slot].max(
+            pending & ~simple)[batch.conc_slot]
+        pure = simple & ~col_conc & ~grow_potential
+        bad_w = straggler & ~pure
+        impure_before = (jnp.cumsum(bad_w.astype(jnp.int32)) -
+                         bad_w.astype(jnp.int32)) > 0
+        s_demand = jnp.where(straggler, batch.need_mb, 0)
+        demand_before = (jnp.cumsum(s_demand) - s_demand).astype(jnp.int32)
+        # the budget must keep sel_i's eligibility bit STABLE for every
+        # earlier straggler too (they run before i sequentially, so their
+        # re-probe must not observe i's commit flipping has_mem at sel_i):
+        # reserve the largest earlier-straggler need on top of their total
+        # demand
+        max_need = jax.lax.cummax(s_demand)
+        max_need_before = jnp.concatenate(
+            [jnp.zeros((1,), max_need.dtype), max_need[:-1]]).astype(jnp.int32)
+        budget_ok = (~take_mem |
+                     (free_at_sel - prior_mem - demand_before
+                      - max_need_before >= batch.need_mb))
+        conc_write = use_conc | (take_mem & ~simple)
+        slot_probed_before = _first_index_where(straggler, batch.conc_slot,
+                                                a_slots)
+        ooo = (pending & placed & ~forced & ~hard_conflict & ~impure_before
+               & budget_ok & ~(conc_write & slot_probed_before))
+
+        # prefix-closure: everything before the first conflict, plus rows
+        # whose outcome no commit can change (valid-but-unplaceable; the
+        # invalid rows never enter `pending`), plus the proven
+        # order-independent commits
+        safe = pending & ((bidx < first_bad) | ~placed | ooo)
+
+        commit = safe & placed
+        dmem = jnp.where(commit & take_mem, batch.need_mb, 0)
+        free_mb = state.free_mb.at[sel].add(-dmem.astype(jnp.int32))
+        conc_delta = jnp.where(
+            commit & use_conc, -1,
+            jnp.where(commit & take_mem & ~simple,
+                      batch.max_conc - 1, 0))
+        conc_free = state.conc_free.at[sel, batch.conc_slot].add(
+            conc_delta.astype(jnp.int32))
+        chosen = jnp.where(safe, jnp.where(placed, sel, jnp.int32(-1)),
+                           chosen)
+        forced_acc = forced_acc | (safe & forced)
+        return (PlacementState(free_mb, conc_free, state.health),
+                pending & ~safe, chosen, forced_acc, rounds + 1)
+
+    state, _, chosen, forced, rounds = jax.lax.while_loop(
+        cond, body, (state, batch.valid,
+                     jnp.full((b,), -1, jnp.int32),
+                     jnp.zeros((b,), bool), jnp.int32(0)))
+    return state, chosen, forced, rounds
+
+
 def _release_one(state: PlacementState, rel) -> Tuple[PlacementState, Tuple]:
     inv, slot, need, max_conc, valid = rel
     simple = valid & (max_conc <= 1)
@@ -181,6 +417,99 @@ def release_batch(state: PlacementState, inv, slot, need_mb, max_conc, valid
     return new_state
 
 
+@jax.jit
+def release_batch_vector(state: PlacementState, inv, slot, need_mb, max_conc,
+                         valid) -> PlacementState:
+    """Bit-exact `release_batch` with the R-length scan vectorized away —
+    the release-side twin of the repair schedule (together they take the
+    fused step's sequential depth from 2B to ~the conflict count).
+
+    Exactness argument, by row class:
+      * simple rows (`max_conc <= 1`) add memory unconditionally and read
+        nothing — one masked scatter-add commutes with everything;
+      * concurrency rows group by (invoker, slot). A HOMOGENEOUS group
+        (all rows share need/max_conc — the invariant the slot allocator
+        maintains, since a slot maps to one action:mem key) evolves the
+        permit cell by +1 per release with a wrap of -max_conc whenever it
+        reaches max_conc, returning the container's memory. k releases
+        from cell value c0 wrap exactly r = clip(floor((c0 + k) /
+        max_conc), 0, k) times (the cell+wraps invariant c_t = c0 + t -
+        max_conc * r_t makes the wrap count a pure division), so the whole
+        group is two scatter-adds;
+      * HETEROGENEOUS groups — possible only under slot-overflow
+        conflation, where two actions share a hashed slot — replay ALL
+        their rows sequentially in batch order under a `lax.while_loop`
+        whose trip count is the row count of conflated groups: zero in
+        steady state, so the loop body never executes.
+    Groups touch disjoint permit cells and memory adds commute, so the
+    three classes compose exactly.
+    """
+    r_len = inv.shape[0]
+    bidx = jnp.arange(r_len, dtype=jnp.int32)
+    simple = valid & (max_conc <= 1)
+    free = state.free_mb.at[inv].add(
+        jnp.where(simple, need_mb, 0).astype(jnp.int32))
+
+    conc_row = valid & (max_conc > 1)
+    # lexicographic (inv, slot) sort via two stable passes; non-conc rows
+    # key to a (-1, -1) sentinel segment that contributes nothing
+    ki = jnp.where(conc_row, inv, -1)
+    ks = jnp.where(conc_row, slot, -1)
+    o1 = jnp.argsort(ks, stable=True)
+    o = o1[jnp.argsort(ki[o1], stable=True)]
+    ki_s, ks_s = ki[o], ks[o]
+    start = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (ki_s[1:] != ki_s[:-1]) | (ks_s[1:] != ks_s[:-1])])
+    gid = jnp.cumsum(start.astype(jnp.int32)) - 1
+    conc_s, need_s, maxc_s = conc_row[o], need_mb[o], max_conc[o]
+    k_g = jnp.zeros((r_len,), jnp.int32).at[gid].add(
+        conc_s.astype(jnp.int32))
+    # the group leader (lowest batch index: stable sorts preserve batch
+    # order within a key) defines the group's expected need/max_conc
+    fneed = jnp.zeros((r_len,), jnp.int32).at[gid].add(
+        jnp.where(start, need_s, 0))
+    fmaxc = jnp.zeros((r_len,), jnp.int32).at[gid].add(
+        jnp.where(start, maxc_s, 0))
+    het_row = conc_s & ((need_s != fneed[gid]) | (maxc_s != fmaxc[gid]))
+    het_g = jnp.zeros((r_len,), bool).at[gid].max(het_row)
+
+    inv_s, slot_s = inv[o], slot[o]
+    apply_leader = start & conc_s & ~het_g[gid]
+    c0 = state.conc_free[inv_s, slot_s]
+    k = k_g[gid]
+    mx = jnp.maximum(maxc_s, 1)  # sentinel rows: avoid div by <= 0
+    wraps = jnp.clip((c0 + k) // mx, 0, k)
+    free = free.at[inv_s].add(
+        jnp.where(apply_leader, need_s * wraps, 0).astype(jnp.int32))
+    conc = state.conc_free.at[inv_s, slot_s].add(
+        jnp.where(apply_leader, k - mx * wraps, 0).astype(jnp.int32))
+
+    # heterogeneous residue: EVERY conc row of a conflated group (the
+    # leader-matching ones included — the bulk apply skipped the whole
+    # group) replays sequentially in batch order; trip count == rows in
+    # conflated groups (normally zero)
+    het_b = jnp.zeros((r_len,), bool).at[o].set(conc_s & het_g[gid])
+
+    def cond(carry):
+        return jnp.any(carry[2])
+
+    def body(carry):
+        free, conc, pending = carry
+        i = jnp.argmin(jnp.where(pending, bidx, r_len))
+        iv, sl = inv[i], slot[i]
+        nd, mc = need_mb[i], max_conc[i]
+        conc_val = conc[iv, sl] + 1
+        reduced = conc_val >= mc
+        free = free.at[iv].add(jnp.where(reduced, nd, 0).astype(jnp.int32))
+        conc = conc.at[iv, sl].add(
+            jnp.where(reduced, 1 - mc, 1).astype(jnp.int32))
+        return free, conc, pending.at[i].set(False)
+
+    free, conc, _ = jax.lax.while_loop(cond, body, (free, conc, het_b))
+    return PlacementState(free, conc, state.health)
+
+
 def make_fused_step(release_fn=None, schedule_fn=None):
     """One jitted device program for the balancer's whole step:
     fold releases -> fold health flips -> schedule the micro-batch.
@@ -188,8 +517,11 @@ def make_fused_step(release_fn=None, schedule_fn=None):
     The three phases as separate calls cost three dispatches per batch
     (dominant at small fleet sizes, where each kernel is ~microseconds);
     fused, XLA compiles them into a single program. Works over any
-    (release_fn, schedule_fn) pair — the XLA kernels (default), the
-    shard_map'd variants, or the pallas schedule.
+    (release_fn, schedule_fn) pair — the XLA kernels (default scan or the
+    repair kernel), the shard_map'd variants, or the pallas schedule.
+
+    Returns (state, chosen, forced, rounds): schedule kernels without a
+    repair loop (scan / pallas / sharded) report rounds == 0.
     """
     release_fn = release_fn or release_batch
     schedule_fn = schedule_fn or schedule_batch
@@ -204,17 +536,20 @@ def make_fused_step(release_fn=None, schedule_fn=None):
         cur = state.health[health_idx]
         state = state._replace(health=state.health.at[health_idx].set(
             jnp.where(health_valid, health_val, cur)))
-        return schedule_fn(state, batch)
+        out = schedule_fn(state, batch)
+        rounds = out[3] if len(out) > 3 else jnp.int32(0)
+        return out[0], out[1], out[2], rounds
 
     return fused
 
 
-def make_release_packed(release_fn=None):
+def make_release_packed(release_fn=None, donate: bool = False):
     """Release-only fold over the packed int32[5,R] matrix (inv, slot, mem,
-    maxc, valid) — the idle-drain counterpart of make_fused_step_packed."""
+    maxc, valid) — the idle-drain counterpart of make_fused_step_packed.
+    `donate=True` donates the state (see make_fused_step_packed)."""
     release_fn = release_fn or release_batch
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=((0,) if donate else ()))
     def packed(state: PlacementState, rel):
         return release_fn(state, rel[0], rel[1], rel[2], rel[3],
                           rel[4].astype(bool))
@@ -222,7 +557,8 @@ def make_release_packed(release_fn=None):
     return packed
 
 
-def make_fused_step_packed(release_fn=None, schedule_fn=None):
+def make_fused_step_packed(release_fn=None, schedule_fn=None,
+                           donate: bool = False):
     """Transfer-packed variant of make_fused_step for the balancer's host
     path. The unpacked signature costs 16 host->device transfers per step
     (8 request columns + 5 release arrays + 3 health arrays) and 2 reads
@@ -230,14 +566,24 @@ def make_fused_step_packed(release_fn=None, schedule_fn=None):
     TRANSFER COUNT — not the kernel — dominates the step. Packing collapses
     the inputs to ONE flat int32 buffer (rel [5*R] ++ health [3*H] ++ req
     [9*B] here, [10*B] in the admit variant; split by static shape inside
-    the program) and the outputs to ONE int32 vector
-    (((chosen+1)<<2) | throttled<<1 | forced — always 0 for throttled here;
-    callers decode with `unpack_chosen`). R/H/B are static per compile; the
-    balancer's power-of-two bucketing bounds the cache-key count.
+    the program) and the outputs to ONE int32 vector: B elements of
+    ((chosen+1)<<2) | throttled<<1 | forced (always 0 for throttled here;
+    callers decode with `unpack_chosen`) plus ONE trailing element carrying
+    the repair-round count (0 for schedule kernels without a repair loop).
+    R/H/B are static per compile; the balancer's power-of-two bucketing
+    bounds the cache-key count.
+
+    `donate=True` donates the state (XLA reuses its buffers for the
+    output): the [N, A] concurrency matrix stops round-tripping through
+    fresh HBM allocations every step. The caller's input reference is
+    INVALIDATED by the call — anything holding the pre-call state (snapshot
+    threads, occupancy readers) must copy it first (see TpuBalancer's
+    materialize boundaries).
     """
     fused = make_fused_step(release_fn, schedule_fn)
 
-    @partial(jax.jit, static_argnums=(2, 3, 4))
+    @partial(jax.jit, static_argnums=(2, 3, 4),
+             donate_argnums=((0,) if donate else ()))
     def packed(state: PlacementState, buf, R: int, H: int, B: int):
         # buf int32[5R+3H+9B]:
         #   rel    [5,R]: inv, slot, mem, maxc, valid
@@ -249,15 +595,17 @@ def make_fused_step_packed(release_fn=None, schedule_fn=None):
         req = buf[5 * R + 3 * H:].reshape(9, B)
         batch = RequestBatch(req[0], req[1], req[2], req[3], req[4], req[5],
                              req[6], req[7], req[8].astype(bool))
-        state, chosen, forced = fused(
+        state, chosen, forced, rounds = fused(
             state, rel[0], rel[1], rel[2], rel[3], rel[4].astype(bool),
             health[0], health[1].astype(bool), health[2].astype(bool), batch)
-        return state, ((chosen + 1) << 2) | forced.astype(jnp.int32)
+        out = ((chosen + 1) << 2) | forced.astype(jnp.int32)
+        return state, jnp.concatenate([out, rounds.reshape(1)])
 
     return packed
 
 
-def make_fused_admit_step_packed(release_fn=None, schedule_fn=None):
+def make_fused_admit_step_packed(release_fn=None, schedule_fn=None,
+                                 donate: bool = False):
     """make_fused_step_packed + device token-bucket admission (ops.throttle):
     the fused program folds releases and health, ADMITS the batch against
     per-namespace buckets (Entitlement.scala:86-153 / RateThrottler.scala as
@@ -266,12 +614,14 @@ def make_fused_admit_step_packed(release_fn=None, schedule_fn=None):
     the packed output and never consume placement capacity.
 
     req grows a 10th row: ns_slot (the balancer's namespace->bucket index).
+    `donate=True` donates the whole (state, buckets) carry.
     """
     from .throttle import admit_batch
 
     fused = make_fused_step(release_fn, schedule_fn)
 
-    @partial(jax.jit, static_argnums=(3, 4, 5))
+    @partial(jax.jit, static_argnums=(3, 4, 5),
+             donate_argnums=((0,) if donate else ()))
     def packed(carry, buf, now, R: int, H: int, B: int):
         state, buckets = carry
         rel = buf[:5 * R].reshape(5, R)
@@ -282,18 +632,27 @@ def make_fused_admit_step_packed(release_fn=None, schedule_fn=None):
         throttled = valid & ~admitted
         batch = RequestBatch(req[0], req[1], req[2], req[3], req[4], req[5],
                              req[6], req[7], admitted)
-        state, chosen, forced = fused(
+        state, chosen, forced, rounds = fused(
             state, rel[0], rel[1], rel[2], rel[3], rel[4].astype(bool),
             health[0], health[1].astype(bool), health[2].astype(bool), batch)
         out = (((chosen + 1) << 2) | (throttled.astype(jnp.int32) << 1)
                | forced.astype(jnp.int32))
-        return (state, buckets), out
+        return (state, buckets), jnp.concatenate([out, rounds.reshape(1)])
 
     return packed
 
 
 def unpack_chosen(out):
-    """Decode the packed step output vector (host numpy or device jnp):
-    -> (chosen int32, forced bool, throttled bool). Throttled requests
-    carry chosen == -1 (they were never scheduled)."""
+    """Decode the packed step output's per-request slice (host numpy or
+    device jnp) -> (chosen int32, forced bool, throttled bool). Throttled
+    requests carry chosen == -1 (they were never scheduled). NOTE: the
+    packed step returns B+1 elements — slice off the trailing repair-round
+    counter (`out[:-1]`) before decoding, or use `unpack_step_output`."""
     return (out >> 2) - 1, (out & 1).astype(bool), ((out >> 1) & 1).astype(bool)
+
+
+def unpack_step_output(out):
+    """Decode a full packed step output vector (B+1 elements):
+    -> (chosen, forced, throttled, repair_rounds int)."""
+    chosen, forced, throttled = unpack_chosen(out[:-1])
+    return chosen, forced, throttled, int(out[-1])

@@ -35,7 +35,11 @@ def init_buckets(n_namespaces: int, rate_per_minute, burst=None
     burst_arr = jnp.broadcast_to(
         jnp.asarray(rate_per_minute if burst is None else burst, jnp.float32),
         (n_namespaces,))
-    return TokenBucketState(burst_arr, rate, burst_arr, jnp.float32(0.0))
+    # tokens starts full (== burst) but must be its OWN buffer: the fused
+    # admit step donates the whole carry, and XLA rejects donating one
+    # buffer twice (`f(donate(a), donate(a))`)
+    return TokenBucketState(jnp.array(burst_arr, copy=True), rate, burst_arr,
+                            jnp.float32(0.0))
 
 
 @jax.jit

@@ -80,3 +80,68 @@ class SeqRingBuffer(Generic[T]):
 
     def __len__(self) -> int:
         return min(self._next_seq, self.size)
+
+
+class ColumnRing:
+    """Growable circular store of fixed-height int32 columns, backing the
+    TPU balancer's zero-copy batch assembly.
+
+    The balancer used to keep queued requests/releases as Python tuples and
+    rebuild the packed device matrix per flush with one
+    `np.array(list_of_tuples).T` — an O(B) Python-object walk plus a
+    transpose copy on every device step. Here each enqueue writes its
+    column straight into a preallocated `int32[rows, cap]` buffer (one
+    C-speed sequence assignment), and a flush drains the k oldest columns
+    with at most two contiguous slice copies — O(1) Python work per
+    activation, no per-flush tuple walk.
+
+    Not thread-safe: all writers/readers live on the balancer's event loop.
+    """
+
+    __slots__ = ("buf", "head", "count")
+
+    def __init__(self, rows: int, cap: int):
+        import numpy as np
+        self.buf = np.zeros((rows, max(8, cap)), np.int32)
+        self.head = 0
+        self.count = 0
+
+    def push(self, col) -> None:
+        """Append one column (any length-`rows` int sequence)."""
+        cap = self.buf.shape[1]
+        if self.count == cap:
+            self._grow()
+            cap = self.buf.shape[1]
+        self.buf[:, (self.head + self.count) % cap] = col
+        self.count += 1
+
+    def pop_into(self, out, k: int) -> None:
+        """Copy the k oldest columns into out[:, :k] (out may carry fewer
+        rows than the ring: extra ring rows are dropped) and consume them."""
+        assert 0 <= k <= self.count
+        rows = out.shape[0]
+        cap = self.buf.shape[1]
+        first = min(k, cap - self.head)
+        out[:, :first] = self.buf[:rows, self.head:self.head + first]
+        if k > first:
+            out[:, first:k] = self.buf[:rows, :k - first]
+        self.head = (self.head + k) % cap
+        self.count -= k
+
+    def clear(self) -> None:
+        self.head = 0
+        self.count = 0
+
+    def _grow(self) -> None:
+        """Double capacity, re-linearizing so head restarts at 0."""
+        import numpy as np
+        cap = self.buf.shape[1]
+        new = np.zeros((self.buf.shape[0], cap * 2), np.int32)
+        first = cap - self.head
+        new[:, :first] = self.buf[:, self.head:]
+        new[:, first:cap] = self.buf[:, :self.head]
+        self.buf = new
+        self.head = 0
+
+    def __len__(self) -> int:
+        return self.count

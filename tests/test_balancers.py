@@ -546,8 +546,13 @@ class TestHealthTestActions:
 
     def test_restore_past_vmem_budget_falls_back_to_xla(self):
         """A snapshot whose n_pad exceeds the pallas VMEM budget must swap
-        in the XLA kernel on restore, exactly as _grow_padding does."""
-        from openwhisk_tpu.ops.placement import release_batch, schedule_batch
+        in the XLA kernel on restore, exactly as _grow_padding does — and
+        the swap must honor the placement-kernel knob (auto resolves the
+        repair pair on the XLA path, scan keeps the legacy pair)."""
+        from openwhisk_tpu.ops.placement import (release_batch,
+                                                 release_batch_vector,
+                                                 schedule_batch,
+                                                 schedule_batch_repair)
 
         provider = MemoryMessagingProvider()
         bal = TpuBalancer(provider, ControllerInstanceId("0"),
@@ -558,8 +563,28 @@ class TestHealthTestActions:
                             action_slots=4096, initial_pad=1, kernel="pallas")
         assert small.kernel == "pallas"
         small.restore(snap)
-        assert small._sched_fn is schedule_batch
-        assert small._release_fn is release_batch
+        assert small.kernel_resolved == "xla"
+        assert small.placement_kernel_resolved == "repair"
+        # auto = the per-bucket hybrid (scan below REPAIR_MIN_BATCH)
+        assert getattr(small._sched_fn, "_placement_hybrid", False)
+        assert getattr(small._release_fn, "_placement_hybrid", False)
+
+        pinned = TpuBalancer(MemoryMessagingProvider(),
+                             ControllerInstanceId("0"),
+                             action_slots=4096, initial_pad=1,
+                             kernel="pallas", placement_kernel="repair")
+        pinned.restore(snap)
+        assert pinned._sched_fn is schedule_batch_repair
+        assert pinned._release_fn is release_batch_vector
+
+        legacy = TpuBalancer(MemoryMessagingProvider(),
+                             ControllerInstanceId("0"),
+                             action_slots=4096, initial_pad=1,
+                             kernel="pallas", placement_kernel="scan")
+        legacy.restore(snap)
+        assert legacy.placement_kernel_resolved == "scan"
+        assert legacy._sched_fn is schedule_batch
+        assert legacy._release_fn is release_batch
 
 
 class TestPipelinedSteps:

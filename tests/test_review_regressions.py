@@ -461,7 +461,6 @@ def test_auto_kernel_outgrow_swaps_to_xla():
     constructor argument."""
     from openwhisk_tpu.controller.loadbalancer import TpuBalancer
     from openwhisk_tpu.core.entity import ControllerInstanceId
-    from openwhisk_tpu.ops.placement import release_batch, schedule_batch
 
     bal = TpuBalancer(MemoryMessagingProvider(), ControllerInstanceId("0"),
                       action_slots=4096, initial_pad=64)
@@ -472,5 +471,8 @@ def test_auto_kernel_outgrow_swaps_to_xla():
     bal.kernel_resolved = "pallas"
     bal._grow_padding(1024)  # (4096+2)*1024*4 bytes >> the 8 MiB budget
     assert bal.kernel_resolved == "xla"
-    assert bal._sched_fn is schedule_batch
-    assert bal._release_fn is release_batch
+    # the swap honors the placement-kernel knob: auto resolves the
+    # per-bucket scan/repair hybrid on the XLA path (PR 5)
+    assert bal.placement_kernel_resolved == "repair"
+    assert getattr(bal._sched_fn, "_placement_hybrid", False)
+    assert getattr(bal._release_fn, "_placement_hybrid", False)
