@@ -273,13 +273,21 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                     telemetry: bool = True,
                     profiling: bool = True,
                     anomaly: bool = True,
+                    waterfall: bool = True,
                     **host_path) -> dict:
     """TpuBalancer.publish() end-to-end on the in-memory bus with echo
     invokers: the full host path (slot alloc, micro-batch assembly, device
     step, promise fan-out, bus send) that the raw kernel number omits.
     `host_path` forwards hot-path knobs (placement_kernel, pipeline_depth,
     donate_state, ring_assembly) straight to the TpuBalancer constructor —
-    the pipeline_speedup rider toggles them."""
+    the pipeline_speedup rider toggles them.
+
+    CLOSED-loop by construction (`concurrency` workers behind a
+    semaphore): the system sets the arrival rate, so the percentiles
+    suffer coordinated omission under saturation — the row says so
+    (`mode: "closed_loop"`) and rides as a comparison beside the
+    `e2e_open_loop` headline (tools/loadgen.py), which measures from
+    scheduled arrival instead."""
     from openwhisk_tpu.controller.loadbalancer import TpuBalancer
     from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
                                            Identity)
@@ -287,6 +295,7 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                                          MemoryMessagingProvider)
     from openwhisk_tpu.ops.profiler import KernelProfiler, ProfilingConfig
     from openwhisk_tpu.utils.transaction import TransactionId
+    from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL
 
     make_action = _bench_action
 
@@ -301,6 +310,11 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         bal.flight_recorder.enabled = flight_recorder
         bal.telemetry.enabled = telemetry
         bal.anomaly.enabled = anomaly
+        # the waterfall plane is process-global (its stages span layers):
+        # toggle + reset it per run so the overhead rider's OFF half is a
+        # true no-op and the ON half starts from clean aggregates
+        GLOBAL_WATERFALL.enabled = waterfall
+        GLOBAL_WATERFALL.reset()
         await bal.start()
         feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
         # wait until supervision has actually registered the fleet (a fixed
@@ -317,6 +331,7 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         actions = [make_action(f"bench{i}", memory=128) for i in range(8)]
         ident = Identity.generate("guest")
         lat: list = []
+        e2e: list = []
         sem = asyncio.Semaphore(concurrency)
 
         async def one(i):
@@ -326,16 +341,25 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                 ident, ActivationId.generate(), ControllerInstanceId("0"),
                 True, {})
             async with sem:
+                if waterfall:
+                    GLOBAL_WATERFALL.begin(msg.activation_id.asString)
                 t0 = time.perf_counter()
                 promise = await bal.publish(action, msg)
                 lat.append(time.perf_counter() - t0)
                 await promise
+                # completion-based e2e beside the publish()-only number:
+                # publish() resolves at PLACEMENT, so its percentiles miss
+                # the produce/pickup/ack half of the path entirely
+                e2e.append(time.perf_counter() - t0)
 
         # warmup: two rounds so the power-of-two schedule/release bucket
         # shapes the measured run will hit are already compiled
         for _ in range(2):
             await asyncio.gather(*[one(i) for i in range(min(128, total))])
         lat.clear()
+        e2e.clear()
+        if waterfall:
+            GLOBAL_WATERFALL.reset()  # drop warmup compile outliers
         # fresh metrics: the warmup rounds polluted the phase histograms
         # with first-call jit-compile outliers (hundreds of ms dispatches)
         bal.metrics = type(bal.metrics)()
@@ -348,6 +372,7 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
             await f.stop()
 
         lat.sort()
+        e2e.sort()
         phases = {}
         for ph in ("assembly", "dispatch", "readback", "fanout"):
             st = bal.metrics.histogram_stats(f"loadbalancer_tpu_{ph}_ms")
@@ -357,9 +382,15 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         bs = bal.metrics.histogram_stats("loadbalancer_tpu_batch_size")
         rounds = bal.metrics.histogram_stats("loadbalancer_repair_rounds")
         return {
+            # closed loop: arrivals are gated on completions, so these
+            # percentiles under-report queueing delay at saturation
+            # (coordinated omission) — the open-loop rider is the headline
+            "mode": "closed_loop",
             "activations_per_sec": round(total / wall, 1),
             "publish_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
             "publish_p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+            "e2e_p50_ms": round(e2e[len(e2e) // 2] * 1e3, 3),
+            "e2e_p99_ms": round(e2e[int(len(e2e) * 0.99)] * 1e3, 3),
             "concurrency": concurrency,
             "n_invokers": n_invokers,
             "phases": phases,
@@ -615,6 +646,32 @@ def _profiling_overhead(**kw) -> Optional[dict]:
 
 def _anomaly_overhead(**kw) -> Optional[dict]:
     return _plane_overhead("anomaly", "anomaly", **kw)
+
+
+def _waterfall_overhead(**kw) -> Optional[dict]:
+    """ISSUE 7 gate: per-activation stage stamping must cost <= 5% through
+    the full balancer path (same protocol as the other four planes)."""
+    return _plane_overhead("waterfall", "waterfall", **kw)
+
+
+def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
+                   max_doublings: int = 7) -> Optional[dict]:
+    """The ISSUE 7 headline rider: open-loop offered-rate sweep against the
+    live balancer path (tools/loadgen.py) — max sustainable activations/s
+    with e2e p50/p99 measured from SCHEDULED arrival time (coordinated-
+    omission-correct, unlike the closed-loop `balancer` rows) plus the
+    waterfall's per-stage budget saying where the per-activation time
+    goes. Acceptance: the stage medians sum to ~the e2e median (no
+    unaccounted gap) and the budget names the stage to attack next."""
+    try:
+        from tools.loadgen import sweep_balancer
+        return sweep_balancer(rate0=rate0, duration=duration,
+                              max_doublings=max_doublings)
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# e2e_open_loop failed: {e!r}", file=sys.stderr)
+        return None
 
 
 def _rider_batch(n_invokers: int, b: int, seed: int = 23):
@@ -1013,9 +1070,16 @@ def _run(args) -> Optional[dict]:
     telemetry_overhead = None
     profiling_overhead = None
     anomaly_overhead = None
+    waterfall_overhead = None
+    e2e_open_loop = None
     repair_vs_scan = None
     pipeline_speedup = None
     if not args.quick:
+        # the new headline first: the open-loop observatory (sustained
+        # activations/s + the per-stage budget the next PR attacks)
+        e2e_open_loop = _run_rider("_e2e_open_loop", _e2e_open_loop)
+        waterfall_overhead = _run_rider("_waterfall_overhead",
+                                        _waterfall_overhead)
         repair_vs_scan = _run_rider("_repair_vs_scan", _repair_vs_scan)
         pipeline_speedup = _run_rider("_pipeline_speedup", _pipeline_speedup)
         recorder_overhead = _run_rider("_flight_recorder_overhead",
@@ -1116,6 +1180,10 @@ def _run(args) -> Optional[dict]:
         out["profiling_overhead"] = profiling_overhead
     if anomaly_overhead is not None:
         out["anomaly_overhead"] = anomaly_overhead
+    if waterfall_overhead is not None:
+        out["waterfall_overhead"] = waterfall_overhead
+    if e2e_open_loop is not None:
+        out["e2e_open_loop"] = e2e_open_loop
     if repair_vs_scan is not None:
         out["repair_vs_scan"] = repair_vs_scan
     if pipeline_speedup is not None:
@@ -1123,6 +1191,7 @@ def _run(args) -> Optional[dict]:
     if any(isinstance(r, dict) and r.get("backend") == "cpu_fallback"
            for r in (recorder_overhead, telemetry_overhead,
                      profiling_overhead, anomaly_overhead,
+                     waterfall_overhead, e2e_open_loop,
                      repair_vs_scan, pipeline_speedup)):
         # a rider lost the device mid-run and re-ran on CPU: say so at the
         # top level so trajectory readers never mistake a CPU number for a

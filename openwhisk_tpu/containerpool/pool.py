@@ -22,6 +22,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 from ..core.entity import ExecutableWhiskAction, MB
 from ..messaging.message import ActivationMessage
 from ..utils.transaction import TransactionId
+from ..utils.waterfall import GLOBAL_WATERFALL, STAGE_CONTAINER_ACQUIRE
 from .factory import ContainerPoolConfig
 from .proxy import ContainerProxy, PAUSED, PAUSING, READY
 
@@ -130,6 +131,11 @@ class ContainerPool:
             self.free.remove(proxy)
         if proxy not in self.busy:
             self.busy.append(proxy)
+        # waterfall: a container (warm, prewarmed or cold shell) is now
+        # committed to this activation — the acquire->run delta is the
+        # cold-start / init cost the waterfall attributes to this stage
+        GLOBAL_WATERFALL.stamp(msg.activation_id.asString,
+                               STAGE_CONTAINER_ACQUIRE)
         self._spawn(proxy.run(action, msg))
         self._emit_gauges()
         return True

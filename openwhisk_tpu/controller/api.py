@@ -128,6 +128,10 @@ class ControllerApi:
         # anomaly scores with bucket-movement evidence (auth-gated)
         r.add_get("/admin/alerts", self.alerts_report)
         r.add_get("/admin/anomalies", self.anomalies_report)
+        # end-to-end latency waterfall: live per-stage percentiles, the
+        # tail budget breakdown and slowest-activation exemplars joined to
+        # flight-recorder trace ids (auth-gated; host-side reads only)
+        r.add_get("/admin/latency/waterfall", self.latency_waterfall)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -194,10 +198,11 @@ class ControllerApi:
         return str(identity.namespace.name) if ns == "_" else ns
 
     async def _check(self, request, right, namespace, throttle=False,
-                     is_trigger_fire=False):
+                     is_trigger_fire=False, waterfall_ctx=None):
         await self.c.entitlement.check(request["identity"], right, namespace,
                                        throttle=throttle,
-                                       is_trigger_fire=is_trigger_fire)
+                                       is_trigger_fire=is_trigger_fire,
+                                       waterfall_ctx=waterfall_ctx)
 
     @staticmethod
     def _list_params(request):
@@ -498,6 +503,40 @@ class ControllerApi:
             report = plane.anomalies_report(names)
         return web.json_response(report)
 
+    async def latency_waterfall(self, request):
+        """Where does the end-to-end latency live: per-stage p50/p90/p99
+        from the waterfall plane's log2 histograms, the stage-median budget
+        against the measured e2e median, dominant-stage tail attribution,
+        and the slowest-activation exemplar rows — each joined to the
+        flight recorder when its placement batch is still in the ring.
+        The plane is host-side numpy only, so this NEVER forces a device
+        sync and runs inline on the event loop. `?recent=N` adds the last
+        N completed rows."""
+        wf = getattr(self.c.load_balancer, "waterfall", None)
+        if wf is None:
+            return _error(404, "this balancer has no latency waterfall",
+                          request.get("transid"))
+        try:
+            recent = max(0, int(request.query.get("recent", 0)))
+        except ValueError:
+            return _error(400, "recent must be an integer",
+                          request.get("transid"))
+        report = wf.report(recent=recent)
+        fr = self._flight_recorder()
+        if fr is not None and report.get("enabled"):
+            for row in report.get("slowest", []):
+                found = fr.explain(row["activation_id"])
+                if found is not None:
+                    batch = found["batch"]
+                    row["placement"] = {
+                        "seq": batch["seq"],
+                        "kernel": batch["digest"].get("kernel"),
+                        "queue_depth": batch["digest"].get("queue_depth"),
+                        "trace_id": batch["digest"].get("trace_id"),
+                        "timings": batch.get("timings", {}),
+                    }
+        return web.json_response(report)
+
     async def placement_occupancy(self, request):
         """Per-invoker slots-in-use/capacity derived from the balancer
         books (device books for the TPU balancer, host semaphores for the
@@ -745,7 +784,16 @@ class ControllerApi:
         return web.json_response(action.to_json())
 
     async def _invoke_action(self, request, ns, fqn):
-        await self._check(request, ACTIVATE, ns, throttle=True)
+        # latency waterfall: anchor the stage vector at handler entry
+        # (api_accept), then thread it through entitle/throttle and — for
+        # the primitive path — down to the activation id minted in
+        # ActionInvoker.invoke. Sequences/compositions anchor their
+        # components at publish instead (each gets its own vector).
+        from ..utils.waterfall import GLOBAL_WATERFALL, STAGE_API_ACCEPT
+        wf_ctx = GLOBAL_WATERFALL.open()
+        GLOBAL_WATERFALL.stamp_ctx(wf_ctx, STAGE_API_ACCEPT)
+        await self._check(request, ACTIVATE, ns, throttle=True,
+                          waterfall_ctx=wf_ctx)
         blocking = self._bool_param(request, "blocking")
         result_only = self._bool_param(request, "result")
         try:
@@ -771,7 +819,8 @@ class ControllerApi:
         else:
             outcome = await self.c.invoker.invoke(
                 request["identity"], action, pkg_params, payload, blocking,
-                transid=request["transid"], wait_override=wait_override)
+                transid=request["transid"], wait_override=wait_override,
+                waterfall_ctx=wf_ctx)
         if outcome.accepted:
             return web.json_response(
                 {"activationId": outcome.activation_id.asString}, status=202)

@@ -126,15 +126,26 @@ class LocalEntitlementProvider:
 
     # -- the check pipeline ------------------------------------------------
     async def check(self, identity: Identity, right: str, namespace: str,
-                    throttle: bool = False, is_trigger_fire: bool = False) -> None:
+                    throttle: bool = False, is_trigger_fire: bool = False,
+                    waterfall_ctx=None) -> None:
+        """`waterfall_ctx` (an un-adopted stage vector from the latency
+        waterfall plane) gets the entitle/throttle stages stamped between
+        the pipeline's two halves, so the end-to-end budget can tell an
+        entitlement-bound tail from a throttle-bound one."""
+        from ..utils.waterfall import (STAGE_ENTITLE, STAGE_THROTTLE,
+                                       ActivationWaterfall)
         if REJECT in identity.rights:
             raise RejectRequest("The subject is not entitled to access this API.")
         if not self._entitled(identity, right, namespace):
             raise RejectRequest(
                 f"The supplied authentication is not authorized to access "
                 f"'{namespace}' with {right} right.")
+        if waterfall_ctx is not None:
+            ActivationWaterfall.stamp_ctx(waterfall_ctx, STAGE_ENTITLE)
         if throttle and right == ACTIVATE:
             self._check_throttles(identity, is_trigger_fire)
+            if waterfall_ctx is not None:
+                ActivationWaterfall.stamp_ctx(waterfall_ctx, STAGE_THROTTLE)
 
     def _check_throttles(self, identity: Identity, is_trigger_fire: bool) -> None:
         ns_id = identity.namespace.uuid.asString

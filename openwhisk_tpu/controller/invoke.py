@@ -74,12 +74,17 @@ class ActionInvoker:
                      package_params: Parameters, payload: Optional[Dict[str, Any]],
                      blocking: bool, transid: Optional[TransactionId] = None,
                      wait_override: Optional[float] = None,
-                     cause: Optional[ActivationId] = None) -> InvokeOutcome:
+                     cause: Optional[ActivationId] = None,
+                     waterfall_ctx: Optional[list] = None) -> InvokeOutcome:
         """invokeSimpleAction (:152-206): parameters merge left-to-right as
         package < action < payload; the message carries only the payload-
-        merged arguments."""
+        merged arguments. `waterfall_ctx` is the REST handler's stage
+        vector (api_accept/entitle/throttle already stamped); direct
+        callers (triggers, sequences) get a fresh vector anchored here so
+        every activation carries a waterfall regardless of entry path."""
         transid = transid or TransactionId()
-        from ..utils.tracing import GLOBAL_TRACER
+        from ..utils.tracing import GLOBAL_TRACER, trace_id_of
+        from ..utils.waterfall import GLOBAL_WATERFALL
         span = GLOBAL_TRACER.start_span("controller_activation", transid)
         args = package_params.merge(action.parameters).merge(
             Parameters.from_arguments(payload or {}))
@@ -95,8 +100,24 @@ class ActionInvoker:
             cause=cause,
             trace_context=GLOBAL_TRACER.get_trace_context(transid),
         )
+        # the activation id exists now: the stage vector becomes reachable
+        # for every later layer (balancer, bus, invoker, pool, batcher)
+        if waterfall_ctx is None:
+            waterfall_ctx = GLOBAL_WATERFALL.open()
+        GLOBAL_WATERFALL.adopt(msg.activation_id.asString, waterfall_ctx,
+                               trace_id=trace_id_of(msg.trace_context))
         try:
-            promise = await self.load_balancer.publish(action, msg)
+            try:
+                promise = await self.load_balancer.publish(action, msg)
+            except (Exception, asyncio.CancelledError):
+                # rejected before entering the pipeline (throttle, no
+                # invokers) or the client went away mid-publish
+                # (CancelledError is BaseException, a bare `except
+                # Exception` would miss it): never completes, so never
+                # finishes — drop the vector instead of leaking it until
+                # eviction pushes out a live activation's
+                GLOBAL_WATERFALL.discard(msg.activation_id.asString)
+                raise
             if not blocking:
                 return InvokeOutcome(None, msg.activation_id, accepted=True)
             wait = min(wait_override or MAX_BLOCKING_WAIT,

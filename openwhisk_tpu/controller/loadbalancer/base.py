@@ -30,6 +30,8 @@ from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
 from ...utils.logging import MetricEmitter
 from ...utils.tracing import trace_id_of
 from ...utils.transaction import TransactionId
+from ...utils.waterfall import (GLOBAL_WATERFALL, STAGE_COMPLETION_ACK,
+                                ActivationWaterfall)
 from ...ops.profiler import KernelProfiler
 from ...ops.telemetry import (OUTCOME_ERROR, OUTCOME_SUCCESS, OUTCOME_TIMEOUT)
 from .anomaly import AnomalyPlane
@@ -92,6 +94,11 @@ class ActivationEntry:
     is_blocking: bool
     #: monotonic stamp at setup — the telemetry plane's e2e latency base
     t_start: float = 0.0
+    #: the waterfall plane's stage vector ([t0_ns, trace_id, s_0..s_N]) —
+    #: the generalization of t_start: one monotonic stamp per pipeline
+    #: stage instead of a single setup time. None when the plane is off or
+    #: the activation entered through a path that never opened a context.
+    stages: Optional[list] = None
     #: forced-timeout timer (a TimerHandle; .cancel() like a Task)
     timeout_task: Optional[asyncio.TimerHandle] = None
     promise: Optional[asyncio.Future] = None
@@ -157,7 +164,8 @@ class CommonLoadBalancer(LoadBalancer):
                  flight_recorder: Optional[FlightRecorder] = None,
                  telemetry: Optional[TelemetryPlane] = None,
                  profiler: Optional[KernelProfiler] = None,
-                 anomaly: Optional[AnomalyPlane] = None):
+                 anomaly: Optional[AnomalyPlane] = None,
+                 waterfall: Optional[ActivationWaterfall] = None):
         self.provider = messaging_provider
         self.controller = controller_instance
         self.logger = logger
@@ -203,6 +211,16 @@ class CommonLoadBalancer(LoadBalancer):
                             invoker_names=self._telemetry_invoker_names)
         self._anomaly_renderer = self.anomaly.prometheus_text
         self.metrics.register_renderer(self._anomaly_renderer)
+        # the latency-waterfall plane (same hook pattern, but PROCESS-WIDE
+        # by default: its stages span layers that never see a balancer —
+        # the API handler, entitlement, messaging producers, invoker,
+        # container pool and record batcher all stamp into GLOBAL_WATERFALL
+        # — while this hook owns the exposition family and the
+        # /admin/latency/waterfall read side)
+        self.waterfall = (waterfall if waterfall is not None
+                          else GLOBAL_WATERFALL)
+        self._waterfall_renderer = self._waterfall_exposition
+        self.metrics.register_renderer(self._waterfall_renderer)
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -298,6 +316,7 @@ class CommonLoadBalancer(LoadBalancer):
             is_blackbox=action.exec_metadata().is_blackbox,
             is_blocking=msg.blocking,
             t_start=time.monotonic(),
+            stages=self.waterfall.ctx_of(msg.activation_id.asString),
             promise=promise,
         )
         # call_later, not a task per activation: a TimerHandle is one heap
@@ -381,6 +400,19 @@ class CommonLoadBalancer(LoadBalancer):
             else:
                 self.metrics.counter("loadbalancer_completion_ack_regular")
             self._telemetry_observe(entry, invoker, forced, is_system_error)
+            # waterfall: the completion ack is the last causally-ordered
+            # stage — stamp it and fold the activation's stage vector into
+            # the per-stage histograms (forced timeouts fold too: their
+            # partial vectors are exactly the tail evidence wanted). The
+            # entry carries the vector (the t_start generalization), so
+            # the stamp goes straight onto it; finish still pops by id.
+            wf = self.waterfall
+            if wf.enabled:
+                if entry.stages is not None:
+                    wf.stamp_ctx(entry.stages, STAGE_COMPLETION_ACK)
+                else:
+                    wf.stamp(aid.asString, STAGE_COMPLETION_ACK)
+                wf.finish(aid.asString)
             self.on_invocation_finished(invoker or (entry.invoker if entry else None),
                                         is_system_error=is_system_error,
                                         forced=forced)
@@ -465,6 +497,9 @@ class CommonLoadBalancer(LoadBalancer):
         return self.telemetry.prometheus_text(
             self._telemetry_invoker_names(), openmetrics=openmetrics)
 
+    def _waterfall_exposition(self, openmetrics: bool = False) -> str:
+        return self.waterfall.prometheus_text(openmetrics=openmetrics)
+
     # -- kernel profiling plane (shared hook, like the flight recorder) ----
     def kernel_profile(self) -> dict:
         """The `GET /admin/profile/kernel` payload. CPU balancers report a
@@ -493,3 +528,4 @@ class CommonLoadBalancer(LoadBalancer):
         self.metrics.unregister_renderer(self._telemetry_renderer)
         self.metrics.unregister_renderer(self._profiler_renderer)
         self.metrics.unregister_renderer(self._anomaly_renderer)
+        self.metrics.unregister_renderer(self._waterfall_renderer)

@@ -38,6 +38,9 @@ class LeanBalancer(CommonLoadBalancer):
 
     async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
                       ) -> asyncio.Future:
+        from ...utils.waterfall import STAGE_PUBLISH_ENQUEUE
+        self.waterfall.stamp(msg.activation_id.asString,
+                             STAGE_PUBLISH_ENQUEUE)
         self.record_placement(msg, action, 0, self.invoker_id,
                               digest={"healthy_invokers": 1})
         promise = self.setup_activation(msg, action, self.invoker_id)

@@ -79,6 +79,9 @@ class ShardingBalancer(CommonLoadBalancer):
 
     async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
                       ) -> asyncio.Future:
+        from ...utils.waterfall import STAGE_PUBLISH_ENQUEUE
+        self.waterfall.stamp(msg.activation_id.asString,
+                             STAGE_PUBLISH_ENQUEUE)
         meta = action.exec_metadata()
         t0 = time.monotonic()
         chosen, forced = schedule(

@@ -56,6 +56,8 @@ from ...ops.throttle import init_buckets
 from ...utils.config import load_config
 from ...utils.ring_buffer import ColumnRing
 from ...utils.tracing import export_tracing_gauges, trace_id_of
+from ...utils.waterfall import (STAGE_BATCH_ASSEMBLE, STAGE_DEVICE_DISPATCH,
+                                STAGE_DEVICE_READBACK, STAGE_PUBLISH_ENQUEUE)
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException, LoadBalancerThrottleException)
 from .flight_recorder import (BatchRecord, free_slot_histogram,
@@ -223,9 +225,10 @@ class TpuBalancer(CommonLoadBalancer):
                  donate_state: Optional[bool] = None,
                  ring_assembly: Optional[bool] = None,
                  prewarm: Optional[bool] = None,
-                 profiler=None, anomaly=None):
+                 profiler=None, anomaly=None, waterfall=None):
         super().__init__(messaging_provider, controller_instance, logger,
-                         metrics, profiler=profiler, anomaly=anomaly)
+                         metrics, profiler=profiler, anomaly=anomaly,
+                         waterfall=waterfall)
         self._cluster_size = cluster_size
         self.kernel = kernel  # "auto" | "xla" | "pallas" (single-device)
         path_cfg = load_config(PlacementPathConfig, env_path="load_balancer")
@@ -862,8 +865,9 @@ class TpuBalancer(CommonLoadBalancer):
         # fail queued publishers instead of leaving them awaiting forever
         pending, self._pending = self._pending, []
         self._req_ring.clear()
-        for req, fut, slot_key, *_ in pending:
+        for req, fut, slot_key, _t, aid, *_ in pending:
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
+            self.waterfall.discard(aid)
             if not fut.done():
                 fut.set_exception(LoadBalancerException("load balancer shut down"))
         # releases queued during the readback drain (abandoned publishers)
@@ -907,9 +911,13 @@ class TpuBalancer(CommonLoadBalancer):
         # trailing fields feed the flight recorder: enqueue time (queue-age
         # digest), the activation/action ids for the decision row, and the
         # trace id (exemplar plumbing on OpenMetrics scrapes)
+        aid_str = msg.activation_id.asString
         entry = (req, fut, slot_key, time.monotonic(),
-                 msg.activation_id.asString, fqn_str,
+                 aid_str, fqn_str,
                  trace_id_of(msg.trace_context))
+        # waterfall: the activation is now IN the balancer's queue — the
+        # delta from here to batch_assemble is pure queueing/window wait
+        self.waterfall.stamp(aid_str, STAGE_PUBLISH_ENQUEUE)
         if self.ring_assembly:
             # the packed-matrix column lands in the preallocated ring NOW
             # (one C-speed write) — flush-time assembly is two slice
@@ -938,16 +946,23 @@ class TpuBalancer(CommonLoadBalancer):
             # same way the readback loop does for futures cancelled earlier.
             if fut.done() and not fut.cancelled() and fut.exception() is None:
                 self._abandon_placement(int(fut.result()[0]), req, slot_key)
+            # abandoned = never acked = never finished: drop the stage
+            # vector too, like every other abandonment path (a cancelled
+            # future's vector would otherwise sit in the active map until
+            # the eviction cap pushed out a LIVE activation's instead)
+            self.waterfall.discard(aid_str)
             raise
         if inv_idx == -2:
             # device token bucket rejected it: no capacity was consumed
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
+            self.waterfall.discard(aid_str)
             self.metrics.counter("loadbalancer_device_throttled")
             raise LoadBalancerThrottleException(
                 "Too many requests in the last minute (device rate "
                 "admission).")
         if inv_idx < 0:
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
+            self.waterfall.discard(aid_str)
             raise LoadBalancerException(
                 "No invokers available to schedule the activation.")
         if forced:
@@ -1304,6 +1319,11 @@ class TpuBalancer(CommonLoadBalancer):
                 # the record carries a trace: the phase histogram's bucket
                 # line gets an exemplar pointing at it (OpenMetrics only)
                 rec.digest["trace_id"] = tid
+        # waterfall: assemble/dispatch/readback are BATCH events — one
+        # shared timestamp per edge for every activation in the batch (the
+        # aid list is built once, only when the plane is live)
+        wf = self.waterfall
+        wf_aids = [e[4] for e in batch] if wf.enabled else None
         rel_np = self._release_packed()
         health_np = self._health_packed()
         # releases + health flips + schedule: ONE device program over ONE
@@ -1331,8 +1351,9 @@ class TpuBalancer(CommonLoadBalancer):
             self._set_inflight(-1)
             self._capacity_free.set()
             self._recover_consumed_state()
-            for req, fut, slot_key, *_ in batch:
+            for req, fut, slot_key, _t, aid, *_ in batch:
                 self._slots.release(slot_key, req[self.R_CONC_SLOT])
+                wf.discard(aid)
                 if not fut.done():
                     fut.set_exception(
                         LoadBalancerException(f"device dispatch failed: {e}"))
@@ -1361,6 +1382,11 @@ class TpuBalancer(CommonLoadBalancer):
         # phase breakdown (bench + ops visibility): assembly is host numpy
         # packing, dispatch is the jit enqueue (transfers + program launch)
         t_dispatched = time.monotonic()
+        if wf_aids is not None:
+            wf.stamp_many(wf_aids, STAGE_BATCH_ASSEMBLE,
+                          int(t_assembled * 1e9))
+            wf.stamp_many(wf_aids, STAGE_DEVICE_DISPATCH,
+                          int(t_dispatched * 1e9))
         self.metrics.histogram("loadbalancer_tpu_assembly_ms",
                                (t_assembled - t0) * 1e3)
         self.metrics.histogram("loadbalancer_tpu_dispatch_ms",
@@ -1477,9 +1503,10 @@ class TpuBalancer(CommonLoadBalancer):
                 # it so the dispatch loop itself survives the outage.
                 compensated = False
                 self._recover_consumed_state()
-            for req, fut, slot_key, *_ in batch:
+            for req, fut, slot_key, _t, aid, *_ in batch:
                 if compensated:
                     self._slots.release(slot_key, req[self.R_CONC_SLOT])
+                self.waterfall.discard(aid)
                 if not fut.done():
                     fut.set_exception(
                         LoadBalancerException(f"device step failed: {e}"))
@@ -1494,6 +1521,10 @@ class TpuBalancer(CommonLoadBalancer):
             return
         self._set_inflight(-1)
         self._capacity_free.set()
+        wf = self.waterfall
+        if wf.enabled:
+            wf.stamp_many([e[4] for e in batch], STAGE_DEVICE_READBACK,
+                          int(t_done * 1e9))
         dt_ms = (t_done - t0) * 1e3
         self.metrics.histogram("loadbalancer_tpu_schedule_batch_ms", dt_ms)
         self.metrics.counter("loadbalancer_tpu_scheduled", b)
@@ -1508,14 +1539,16 @@ class TpuBalancer(CommonLoadBalancer):
             if rec is not None:
                 rec.digest["repair_rounds"] = rounds
         t_f0 = time.monotonic()
-        for (req, fut, slot_key, *_), inv_idx, f, thr in zip(
+        for (req, fut, slot_key, _t, aid, *_), inv_idx, f, thr in zip(
                 batch, chosen_np, forced_np, throttled_np):
             if fut.cancelled():
                 # abandoned publisher (client disconnected while awaiting
                 # placement): nobody will ever ack this activation, so give
                 # back what the schedule fold reserved for it (throttled
-                # requests carry chosen == -1: nothing was reserved)
+                # requests carry chosen == -1: nothing was reserved) —
+                # and drop its waterfall vector, which will never finish
                 self._abandon_placement(int(inv_idx), req, slot_key)
+                wf.discard(aid)
             elif not fut.done():
                 fut.set_result((-2 if thr else int(inv_idx), bool(f)))
         t_f1 = time.monotonic()

@@ -67,12 +67,26 @@ class Batcher(Generic[T, R]):
             items = [i for i, _ in batch]
             try:
                 results = await self.operation(items)
-                for (_, fut), r in zip(batch, results):
+                for (item, fut), r in zip(batch, results):
                     if not fut.done():
                         fut.set_result(r)
+                    self._stamp_written(item)
             except Exception as e:  # noqa: BLE001 — propagate to each waiter
                 for _, fut in batch:
                     if not fut.done():
                         fut.set_exception(e)
         finally:
             self._sem.release()
+
+    @staticmethod
+    def _stamp_written(item) -> None:
+        """Waterfall `record_write` edge for activation-record batches:
+        the item's write is durable the moment its flush lands, which under
+        coalescing can be well after the invoker queued it — stamping here
+        (not at put()) keeps the stage honest about batching delay. Items
+        without an activation_id (other document types) no-op."""
+        aid = getattr(item, "activation_id", None)
+        if aid is not None:
+            from ..utils.waterfall import (GLOBAL_WATERFALL,
+                                           STAGE_RECORD_WRITE)
+            GLOBAL_WATERFALL.stamp(aid.asString, STAGE_RECORD_WRITE)

@@ -26,6 +26,8 @@ from ..messaging.message import (ActivationMessage,
                                  CompletionMessage, PingMessage, ResultMessage)
 from ..utils.scheduler import Scheduler
 from ..utils.transaction import TransactionId
+from ..utils.waterfall import (GLOBAL_WATERFALL, STAGE_INVOKER_PICKUP,
+                               STAGE_RECORD_WRITE, STAGE_RUN)
 
 
 
@@ -138,6 +140,11 @@ class InvokerReactive:
             release()
             return
         from ..utils.tracing import GLOBAL_TRACER
+        # waterfall: the activation is off the bus and in the invoker's
+        # hands (single-process deployments share the controller's stage
+        # map; separate processes no-op on the unknown id)
+        GLOBAL_WATERFALL.stamp(msg.activation_id.asString,
+                               STAGE_INVOKER_PICKUP)
         # stack-free span: concurrent activations may SHARE a transid (all
         # rules of one trigger fire), so the span is keyed by activation id
         # and parented straight from the message's trace context
@@ -179,6 +186,9 @@ class InvokerReactive:
 
     async def _active_ack(self, transid, activation: WhiskActivation, blocking,
                           controller, user, kind: str) -> None:
+        # waterfall: user code is done (init + run); the ack produce and
+        # the controller's completion processing are the remaining edges
+        GLOBAL_WATERFALL.stamp(activation.activation_id.asString, STAGE_RUN)
         topic = f"completed{controller.as_string}"
         if kind == "result":
             message = ResultMessage(transid, activation)
@@ -225,6 +235,13 @@ class InvokerReactive:
     async def _store_activation(self, transid, activation, user) -> None:
         try:
             await self.activation_store.store(activation, context=user)
+            # waterfall: the record is durable. May land BEFORE the
+            # controller's completion_ack stamp (the ack is sent first but
+            # consumed asynchronously) — the plane clamps that delta to 0 —
+            # or AFTER the row finalized, where it no-ops. First-wins also
+            # dedupes against the batcher-level stamp.
+            GLOBAL_WATERFALL.stamp(activation.activation_id.asString,
+                                   STAGE_RECORD_WRITE)
         except Exception as e:  # noqa: BLE001 — losing a record must not kill the loop
             if self.logger:
                 self.logger.error(transid, f"failed to store activation: {e!r}",

@@ -14,6 +14,17 @@ import asyncio
 from typing import Awaitable, Callable, List, Optional, Tuple
 
 from ..utils.transaction import TransactionId
+from ..utils.waterfall import GLOBAL_WATERFALL, STAGE_PRODUCE
+
+
+def stamp_produce(msg) -> None:
+    """Waterfall `produce` edge, shared by every bus backend's producer:
+    first-wins, so only the controller->invoker hand-off sets it (the
+    completion ack also carries an activation_id but lands second, and
+    cross-process peers stamp into an empty map — a no-op)."""
+    aid = getattr(msg, "activation_id", None)
+    if aid is not None:
+        GLOBAL_WATERFALL.stamp(aid.asString, STAGE_PRODUCE)
 
 
 class MessageProducer:

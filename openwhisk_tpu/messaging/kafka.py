@@ -54,6 +54,8 @@ class KafkaProducer(MessageProducer):
         payload = msg if isinstance(msg, (bytes, bytearray)) else msg.serialize()
         await self._producer.send_and_wait(topic, bytes(payload))
         self._sent += 1
+        from .connector import stamp_produce
+        stamp_produce(msg)  # waterfall produce edge (broker-acknowledged)
 
     async def close(self) -> None:
         if self._started:

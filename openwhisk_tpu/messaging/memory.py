@@ -16,7 +16,8 @@ import itertools
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from .connector import MessageConsumer, MessageProducer, MessagingProvider
+from .connector import (MessageConsumer, MessageProducer, MessagingProvider,
+                        stamp_produce)
 
 
 #: backstop per-group retention — bounds queues of groups nobody drains
@@ -86,6 +87,7 @@ class MemoryProducer(MessageProducer):
                 t.queue_for("__default__").append((off, bytes(payload)))
             self._sent += 1
             t.cond.notify_all()
+        stamp_produce(msg)  # waterfall produce edge
 
 
 class MemoryConsumer(MessageConsumer):

@@ -184,6 +184,8 @@ class TcpProducer(MessageProducer):
                                   "mid": uuid.uuid4().hex,
                                   "payload": base64.b64encode(bytes(payload)).decode()})
         self._sent += 1
+        from .connector import stamp_produce
+        stamp_produce(msg)  # waterfall produce edge (broker-acknowledged)
 
     async def close(self) -> None:
         await self._conn.close()

@@ -148,9 +148,33 @@ class Client:
             return r.status
 
 
+async def open_loop(n_requests: int, rate: float,
+                    one: Callable[[int], Awaitable[bool]],
+                    dist: str = "poisson", seed: int = 1) -> Stats:
+    """Open-loop counterpart of `timed_loop`: arrivals follow a fixed
+    schedule (tools/loadgen.make_schedule — the shared arrival-schedule
+    helper) independent of completions, and each latency is measured from
+    the SCHEDULED arrival time, so queueing behind a stalled system is
+    charged to the system (coordinated-omission-correct; `timed_loop`'s
+    semaphore workers self-throttle and under-report exactly that).
+    Unfinished requests after the drain window count as errors."""
+    from tools.loadgen import make_schedule
+    from tools.loadgen import open_loop as _drive
+
+    async def wrapped(i: int, sched_ns: int) -> bool:
+        return await one(i)
+
+    row = await _drive(wrapped, make_schedule(rate, n_requests, dist=dist,
+                                              seed=seed))
+    return Stats("", row["samples_ms"], row["wall_s"],
+                 row["errors"] + row["unfinished"])
+
+
 async def timed_loop(n_requests: int, concurrency: int,
                      one: Callable[[int], Awaitable[bool]]) -> Stats:
-    """Run `one(i)` n_requests times at the given concurrency; time each."""
+    """Run `one(i)` n_requests times at the given concurrency; time each.
+    CLOSED loop: arrivals gate on completions — fine for smoke coverage,
+    use `open_loop` when the percentiles are the point."""
     samples: List[float] = []
     errors = 0
     sem = asyncio.Semaphore(concurrency)

@@ -19,9 +19,9 @@ import sys
 import time
 
 try:
-    from harness import NOOP_CODE, Client, run_with_standalone
+    from harness import NOOP_CODE, Client, open_loop, run_with_standalone
 except ImportError:
-    from .harness import NOOP_CODE, Client, run_with_standalone
+    from .harness import NOOP_CODE, Client, open_loop, run_with_standalone
 
 
 def _summary(name: str, xs) -> str:
@@ -47,7 +47,8 @@ async def _activation_timings(client: Client, activation_id: str,
     return {}
 
 
-async def _main(client: Client, samples: int, ratio: int) -> None:
+async def _main(client: Client, samples: int, ratio: int,
+                rate: float = 0.0) -> None:
     # setup: one action, one trigger, `ratio` rules binding them
     assert await client.put_action("owperf-act") == 200
     async with client.session.put(
@@ -65,20 +66,48 @@ async def _main(client: Client, samples: int, ratio: int) -> None:
     e2e_action, e2e_rule = [], []
     waits, inits, durs = [], [], []
 
-    # direct action samples (owperf "action" test)
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        status, body = await client.invoke("owperf-act")
-        e2e_action.append((time.perf_counter() - t0) * 1e3)
-        assert status == 200
-        t = await _activation_timings(client, body["activationId"])
-        if not t:  # record never surfaced: drop the sample, don't zero-fill
-            print(f"activation {body['activationId']} record missing",
+    # direct action samples (owperf "action" test). With --rate the phase
+    # runs OPEN-loop through the shared arrival schedule (tools/loadgen
+    # via harness.open_loop): invokes fire at scheduled times, latency is
+    # measured from the schedule, and the record mining happens after the
+    # drive so polling never perturbs the arrival process.
+    if rate > 0:
+        aids = []
+
+        async def one(i: int) -> bool:
+            status, body = await client.invoke("owperf-act")
+            if status != 200:
+                return False
+            aids.append(body["activationId"])
+            return True
+
+        stats = await open_loop(samples, rate, one)
+        e2e_action = stats.samples_ms
+        if stats.errors:
+            print(f"{stats.errors} open-loop action samples failed",
                   file=sys.stderr)
-            continue
-        waits.append(t["waitTime"])
-        inits.append(t["initTime"])
-        durs.append(t["duration"])
+        for aid in aids:
+            t = await _activation_timings(client, aid)
+            if not t:
+                print(f"activation {aid} record missing", file=sys.stderr)
+                continue
+            waits.append(t["waitTime"])
+            inits.append(t["initTime"])
+            durs.append(t["duration"])
+    else:
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            status, body = await client.invoke("owperf-act")
+            e2e_action.append((time.perf_counter() - t0) * 1e3)
+            assert status == 200
+            t = await _activation_timings(client, body["activationId"])
+            if not t:  # record never surfaced: drop, don't zero-fill
+                print(f"activation {body['activationId']} record missing",
+                      file=sys.stderr)
+                continue
+            waits.append(t["waitTime"])
+            inits.append(t["initTime"])
+            durs.append(t["duration"])
 
     # rule samples (owperf "rule" test): fire -> poll for the rule-driven
     # activation recorded in the trigger activation's log entries
@@ -114,7 +143,8 @@ async def _main(client: Client, samples: int, ratio: int) -> None:
         e2e_rule.append((time.perf_counter() - t0) * 1e3)
 
     print("phase,samples,mean_ms,p50_ms,p90_ms,max_ms")
-    print(_summary("action_e2e", e2e_action))
+    print(_summary("action_e2e" + (f"_open@{rate:g}" if rate > 0 else ""),
+                   e2e_action))
     print(_summary(f"rule_e2e_x{ratio}", e2e_rule))
     print(_summary("waitTime", waits))
     print(_summary("initTime", inits))
@@ -126,11 +156,14 @@ def main() -> None:
     ap.add_argument("--samples", type=int, default=50)
     ap.add_argument("--ratio", type=int, default=1,
                     help="rules per trigger (owperf -ratio)")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop offered rate for the action phase "
+                         "(requests/s, 0 = legacy closed-loop sampling)")
     ap.add_argument("--port", type=int, default=13377)
     args = ap.parse_args()
 
     async def go(client: Client):
-        await _main(client, args.samples, args.ratio)
+        await _main(client, args.samples, args.ratio, rate=args.rate)
 
     run_with_standalone(go, port=args.port)
 

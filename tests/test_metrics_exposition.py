@@ -169,6 +169,14 @@ class TestExpositionFormat:
             try:
                 action = make_action("exposed", memory=128)
                 msgs = [make_msg(action, ident, True) for _ in range(8)]
+                # waterfall contexts so the stage-duration family renders
+                # (production opens them in the REST handler; this test
+                # publishes straight into the balancer)
+                from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL
+                GLOBAL_WATERFALL.enabled = True
+                GLOBAL_WATERFALL.reset()
+                for m in msgs:
+                    GLOBAL_WATERFALL.begin(m.activation_id.asString)
                 await asyncio.gather(*[await bal.publish(action, m)
                                        for m in msgs])
                 await asyncio.sleep(0.3)
@@ -261,6 +269,20 @@ class TestExpositionFormat:
                 'transition="firing"} 1') in text
         # tracing health gauges (satellite: orphan finishes are visible)
         assert types["openwhisk_tracing_orphan_finishes"] == "gauge"
+        # the latency-waterfall plane's families (ISSUE 7): per-stage e2e
+        # timing as a REAL histogram family — the grammar pass above
+        # already proved names, label escaping and monotone cumulative
+        # `le` for every histogram on the page, this pins the family in
+        assert types[
+            "openwhisk_activation_stage_duration_seconds"] == "histogram"
+        wf_stages = {dict(k[1]).get("stage") for k in out["histograms"]
+                     if k[0] == "openwhisk_activation_stage_duration_seconds"}
+        assert {"publish_enqueue", "device_dispatch", "produce",
+                "completion_ack"} <= wf_stages
+        assert types[
+            "openwhisk_activation_dominant_stage_total"] == "counter"
+        assert 'openwhisk_activation_dominant_stage_total{scope="all"' \
+            in text
 
 
 class TestOpenMetricsExemplars:

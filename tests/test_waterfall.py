@@ -1,0 +1,519 @@
+"""Activation latency waterfall (ISSUE 7): stage stamping, aggregation,
+the balancer hook, the admin endpoint, and the disabled-is-no-op contract.
+"""
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from openwhisk_tpu.utils.waterfall import (
+    GLOBAL_WATERFALL, N_STAGES, STAGE_API_ACCEPT, STAGE_BATCH_ASSEMBLE,
+    STAGE_COMPLETION_ACK, STAGE_DEVICE_DISPATCH, STAGE_DEVICE_READBACK,
+    STAGE_ENTITLE, STAGE_PRODUCE, STAGE_PUBLISH_ENQUEUE, STAGE_RECORD_WRITE,
+    STAGE_RUN, STAGE_THROTTLE, STAGES, ActivationWaterfall, WaterfallConfig,
+    bucket_bounds_ms, bucket_of_us)
+
+
+def make_wf(**kw):
+    return ActivationWaterfall(WaterfallConfig(**kw))
+
+
+class TestBucketMath:
+    def test_integer_exact_log2(self):
+        nb = 30
+        assert bucket_of_us(0, nb) == 0
+        assert bucket_of_us(1, nb) == 0
+        assert bucket_of_us(2, nb) == 1
+        assert bucket_of_us(3, nb) == 2
+        assert bucket_of_us(4, nb) == 2
+        assert bucket_of_us(5, nb) == 3
+        # exact powers land in their own bucket, never the neighbour
+        for i in range(1, 20):
+            assert bucket_of_us(2 ** i, nb) == i
+            assert bucket_of_us(2 ** i + 1, nb) == i + 1
+        # overflow clamps to the last bucket
+        assert bucket_of_us(2 ** 60, nb) == nb - 1
+
+    def test_bounds_match_buckets(self):
+        bounds = bucket_bounds_ms(30)
+        assert len(bounds) == 29
+        assert bounds[0] == 0.001  # 1 us
+        assert bounds[10] == 2 ** 10 / 1000.0
+
+
+class TestStampAndFinish:
+    def test_deltas_between_consecutive_present_stages(self):
+        wf = make_wf()
+        t0 = 1_000_000_000
+        ctx = wf.open(t0_ns=t0)
+        wf.adopt("a", ctx)
+        wf.stamp("a", STAGE_PUBLISH_ENQUEUE, t0 + 2_000_000)   # +2 ms
+        wf.stamp("a", STAGE_DEVICE_READBACK, t0 + 5_000_000)   # +3 ms
+        wf.stamp("a", STAGE_COMPLETION_ACK, t0 + 9_000_000)    # +4 ms
+        row = wf.finish("a")
+        d = row["deltas_us"]
+        assert d[STAGE_PUBLISH_ENQUEUE] == 2000
+        # absent stages absorb into the NEXT present stage's delta —
+        # nothing is ever unaccounted
+        assert d[STAGE_BATCH_ASSEMBLE] == -1
+        assert d[STAGE_DEVICE_READBACK] == 3000
+        assert d[STAGE_COMPLETION_ACK] == 4000
+        assert row["total_us"] == 9000
+        assert sum(x for x in d if x > 0) == row["total_us"]
+        assert row["clamped"] == 0
+
+    def test_first_write_wins(self):
+        wf = make_wf()
+        wf.begin("a", t0_ns=0)
+        wf.stamp("a", STAGE_PRODUCE, 5_000_000)
+        wf.stamp("a", STAGE_PRODUCE, 9_000_000)  # the ack's re-carry: no-op
+        wf.stamp("a", STAGE_COMPLETION_ACK, 10_000_000)
+        row = wf.finish("a")
+        assert row["deltas_us"][STAGE_PRODUCE] == 5000
+
+    def test_record_write_race_clamps_to_zero(self):
+        wf = make_wf()
+        wf.begin("a", t0_ns=0)
+        wf.stamp("a", STAGE_RUN, 1_000_000)
+        # record stored BEFORE the controller processed the ack
+        wf.stamp("a", STAGE_RECORD_WRITE, 2_000_000)
+        wf.stamp("a", STAGE_COMPLETION_ACK, 3_000_000)
+        row = wf.finish("a")
+        assert row["deltas_us"][STAGE_RECORD_WRITE] == 0
+        assert row["deltas_us"][STAGE_COMPLETION_ACK] == 2000
+        # the record_write clamp is EXPECTED (documented race), not counted
+        assert row["clamped"] == 0
+        assert row["total_us"] == 3000
+
+    def test_out_of_order_pipeline_stage_is_counted(self):
+        wf = make_wf()
+        wf.begin("a", t0_ns=0)
+        wf.stamp("a", STAGE_DEVICE_READBACK, 5_000_000)
+        wf.stamp("a", STAGE_PRODUCE, 3_000_000)  # impossible causally
+        wf.stamp("a", STAGE_COMPLETION_ACK, 6_000_000)
+        assert wf.finish("a")["clamped"] == 1
+
+    def test_finish_unknown_or_unstamped_is_none(self):
+        wf = make_wf()
+        assert wf.finish("nope") is None
+        wf.begin("empty", t0_ns=0)
+        assert wf.finish("empty") is None  # no stamps at all
+
+    def test_stamp_many_shares_one_timestamp(self):
+        wf = make_wf()
+        for a in ("a", "b"):
+            wf.begin(a, t0_ns=0)
+        wf.stamp_many(["a", "b", "ghost"], STAGE_BATCH_ASSEMBLE, 7_000_000)
+        for a in ("a", "b"):
+            wf.stamp(a, STAGE_COMPLETION_ACK, 8_000_000)
+            assert wf.finish(a)["deltas_us"][STAGE_BATCH_ASSEMBLE] == 7000
+
+    def test_active_map_eviction_cap(self):
+        wf = make_wf(max_active=4)
+        for i in range(7):
+            wf.begin(f"a{i}")
+        assert wf.active == 4
+        assert wf.evicted_active == 3
+        assert wf.ctx_of("a0") is None     # oldest evicted first
+        assert wf.ctx_of("a6") is not None
+
+    def test_discard_drops_without_aggregating(self):
+        wf = make_wf()
+        wf.begin("a", t0_ns=0)
+        wf.stamp("a", STAGE_PUBLISH_ENQUEUE, 1_000_000)
+        wf.discard("a")
+        assert wf.active == 0
+        assert wf.report()["finished"] == 0
+
+
+class TestAggregates:
+    def _feed(self, wf, n=100, slow_every=10):
+        for i in range(n):
+            t0 = i * 1_000_000_000
+            wf.begin(f"a{i}", t0_ns=t0)
+            enq = 1_000_000 if i % slow_every else 20_000_000  # 1 ms / 20 ms
+            wf.stamp(f"a{i}", STAGE_PUBLISH_ENQUEUE, t0 + enq)
+            wf.stamp(f"a{i}", STAGE_COMPLETION_ACK, t0 + enq + 2_000_000)
+            wf.finish(f"a{i}")
+
+    def test_dominant_stage_counter(self):
+        wf = make_wf()
+        self._feed(wf, n=100)
+        tail = wf.tail_attribution()
+        # 90 fast rows are dominated by completion_ack (2ms > 1ms), the 10
+        # slow ones by the 20ms enqueue wait
+        assert tail["dominant"]["completion_ack"] == 90
+        assert tail["dominant"]["publish_enqueue"] == 10
+        # the p99-tail attribution fingers the enqueue wait specifically
+        assert set(tail["dominant_tail"]) == {"publish_enqueue"}
+
+    def test_budget_decomposition_telescopes(self):
+        wf = make_wf()
+        self._feed(wf, n=100)
+        b = wf.budget()
+        assert b["count"] == 100
+        # the p50-band decomposition sums to the band's e2e (~3 ms)
+        assert b["coverage_ratio"] == pytest.approx(1.0, abs=0.1)
+        assert b["e2e_p50_ms"] == pytest.approx(3.0, rel=0.1)
+        # the p99 decomposition isolates the slow enqueue tail
+        assert b["p99_decomposition_ms"]["publish_enqueue"] == \
+            pytest.approx(20.0, rel=0.05)
+
+    def test_exemplars_zero_disables_without_crashing(self):
+        """Regression: exemplars=0 used to IndexError inside finish() (on
+        the completion-ack path) at the first completed activation."""
+        wf = make_wf(exemplars=0)
+        self._feed(wf, n=5)
+        assert wf.slowest() == []
+        assert wf.report()["finished"] == 5
+
+    def test_budget_coverage_stable_on_tiny_windows(self):
+        """Regression: the p50 band was a quantile-range slice that could
+        exclude the median row at small n, skewing coverage_ratio far from
+        1 on skewed 6-row windows. The band is centered on the median row
+        now."""
+        wf = make_wf()
+        # heavily skewed totals: 1,1,1,1,1,100 ms
+        for i, total in enumerate([1, 1, 1, 1, 1, 100]):
+            t0 = i * 1_000_000_000
+            wf.begin(f"a{i}", t0_ns=t0)
+            wf.stamp(f"a{i}", STAGE_COMPLETION_ACK, t0 + total * 1_000_000)
+            wf.finish(f"a{i}")
+        b = wf.budget()
+        assert b["coverage_ratio"] == pytest.approx(1.0, abs=0.15)
+
+    def test_slowest_exemplars_sorted_and_capped(self):
+        wf = make_wf(exemplars=3)
+        self._feed(wf, n=50)
+        slow = wf.slowest()
+        assert len(slow) == 3
+        totals = [r["total_ms"] for r in slow]
+        assert totals == sorted(totals, reverse=True)
+        assert totals[0] == pytest.approx(22.0, rel=0.05)
+
+    def test_prometheus_family_grammar(self):
+        from tests.test_metrics_exposition import validate_exposition
+        wf = make_wf()
+        self._feed(wf, n=20)
+        text = wf.prometheus_text()
+        out = validate_exposition(text)
+        assert out["types"][
+            "openwhisk_activation_stage_duration_seconds"] == "histogram"
+        assert out["types"][
+            "openwhisk_activation_dominant_stage_total"] == "counter"
+        stages = {dict(k[1]).get("stage") for k in out["histograms"]}
+        assert {"publish_enqueue", "completion_ack"} <= stages
+
+    def test_reset_clears_everything(self):
+        wf = make_wf()
+        self._feed(wf, n=10)
+        wf.begin("inflight")
+        wf.reset()
+        assert wf.active == 0
+        assert wf.report()["finished"] == 0
+        assert wf.prometheus_text() == ""
+
+
+class TestDisabledNoOp:
+    """`CONFIG_whisk_waterfall_enabled=false` must be a TRUE no-op."""
+
+    def test_disabled_plane_never_allocates(self):
+        wf = make_wf(enabled=False)
+        assert wf.open() is None
+        assert wf.begin("a") is None
+        wf.stamp("a", STAGE_PUBLISH_ENQUEUE)
+        wf.stamp_many(["a", "b"], STAGE_BATCH_ASSEMBLE)
+        ActivationWaterfall.stamp_ctx(None, STAGE_ENTITLE)
+        assert wf.active == 0
+        assert wf.finish("a") is None
+        assert wf.prometheus_text() == ""
+        assert wf.report() == {"enabled": False}
+
+    def test_env_off_switch(self, monkeypatch):
+        monkeypatch.setenv("CONFIG_whisk_waterfall_enabled", "false")
+        assert ActivationWaterfall.from_config().enabled is False
+        monkeypatch.setenv("CONFIG_whisk_waterfall_enabled", "true")
+        monkeypatch.setenv("CONFIG_whisk_waterfall_ring", "64")
+        wf = ActivationWaterfall.from_config()
+        assert wf.enabled is True and wf.config.ring == 64
+
+    def test_disabled_publish_path_is_untouched(self):
+        """A full publish->completion cycle through the TPU balancer with
+        the plane off: no contexts, no rows, no exposition — and the
+        activation still completes normally."""
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+        from tests.test_balancers import _fleet, _ping_all, make_action, \
+            make_msg
+
+        wf = make_wf(enabled=False)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              waterfall=wf)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            try:
+                ident = Identity.generate("guest")
+                action = make_action("wf-off", memory=128)
+                msg = make_msg(action, ident, True)
+                promise = await bal.publish(action, msg)
+                await promise
+            finally:
+                await bal.close()
+                for inv in invokers:
+                    await inv.stop()
+
+        asyncio.run(go())
+        assert wf.active == 0
+        assert wf.report() == {"enabled": False}
+
+
+class TestBalancerIntegration:
+    """Stamps threaded through the real TpuBalancer dispatch pipeline.
+
+    Uses GLOBAL_WATERFALL (reset around the run): the produce edge lives
+    in the messaging producers, which — like the invoker/pool/batcher —
+    stamp the process-wide plane, not a balancer-injected instance."""
+
+    def _run(self, wf, n=8):
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+        from tests.test_balancers import _fleet, _ping_all, make_action, \
+            make_msg
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              waterfall=wf)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            try:
+                ident = Identity.generate("guest")
+                action = make_action("wf-on", memory=128)
+                promises = []
+                for _ in range(n):
+                    msg = make_msg(action, ident, True)
+                    wf.begin(msg.activation_id.asString)
+                    promises.append(await bal.publish(action, msg))
+                await asyncio.gather(*promises)
+                await asyncio.sleep(0.2)
+            finally:
+                await bal.close()
+                for inv in invokers:
+                    await inv.stop()
+
+        asyncio.run(go())
+
+    def test_pipeline_stages_stamped_and_monotone(self):
+        wf = GLOBAL_WATERFALL
+        wf.enabled = True
+        wf.reset()
+        self._run(wf, n=8)
+        rows = wf.recent(8)
+        assert len(rows) == 8
+        want = {"publish_enqueue", "batch_assemble", "device_dispatch",
+                "device_readback", "produce", "completion_ack"}
+        for row in rows:
+            assert want <= set(row["stages_ms"]), row
+            assert row["clamped"] == 0  # causal order held
+            assert row["total_ms"] == pytest.approx(
+                sum(row["stages_ms"].values()), abs=0.05)
+        # the generalized ActivationEntry.t_start: entries carried the
+        # stage vector while in flight (all finished now)
+        assert wf.active == 0
+
+    def test_cancelled_publisher_discards_context(self):
+        """Regression: a client that disconnects mid-publish (cancellation)
+        must not leak its stage vector — every abandonment path discards,
+        and a leak here would eventually evict LIVE activations' vectors
+        at the max_active cap."""
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+        from tests.test_balancers import _fleet, _ping_all, make_action, \
+            make_msg
+
+        wf = make_wf()
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              waterfall=wf)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            try:
+                ident = Identity.generate("guest")
+                action = make_action("wf-cancel", memory=128)
+                msg = make_msg(action, ident, True)
+                wf.begin(msg.activation_id.asString)
+                task = asyncio.ensure_future(bal.publish(action, msg))
+                await asyncio.sleep(0)  # let publish enqueue, then bail
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
+                # drain the dispatched step so the fanout path runs too
+                await asyncio.sleep(0.3)
+            finally:
+                await bal.close()
+                for inv in invokers:
+                    await inv.stop()
+
+        asyncio.run(go())
+        assert wf.active == 0, "cancelled publisher leaked its stage vector"
+
+    def test_entry_carries_stage_vector(self):
+        """setup_activation links the waterfall ctx into the entry — the
+        t_start generalization."""
+        from openwhisk_tpu.controller.loadbalancer.base import \
+            ActivationEntry
+        assert "stages" in ActivationEntry.__dataclass_fields__
+
+
+class TestAdminEndpoint:
+    PORT = 13391
+
+    def test_waterfall_endpoint_with_flight_recorder_join(self):
+        from openwhisk_tpu.controller.core import Controller
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.core.entity import (ControllerInstanceId,
+                                               Identity, WhiskAuthRecord)
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+        from openwhisk_tpu.utils.logging import NullLogging
+        from tests.test_balancers import _fleet, _ping_all, make_action, \
+            make_msg
+        import aiohttp
+
+        wf = make_wf()
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            logger = NullLogging()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              logger=logger, metrics=logger.metrics,
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              waterfall=wf)
+            controller = Controller(ControllerInstanceId("0"), provider,
+                                    logger=logger, load_balancer=bal)
+            ident = Identity.generate("guest")
+            await controller.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await controller.start(port=self.PORT)
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            try:
+                action = make_action("wf-admin", memory=128)
+                promises = []
+                for _ in range(6):
+                    msg = make_msg(action, ident, True)
+                    wf.begin(msg.activation_id.asString)
+                    promises.append(await bal.publish(action, msg))
+                await asyncio.gather(*promises)
+                await asyncio.sleep(0.2)
+                import base64
+                hdrs = {"Authorization": "Basic " + base64.b64encode(
+                    ident.authkey.compact.encode()).decode()}
+                base = f"http://127.0.0.1:{self.PORT}"
+                out = {}
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"{base}/admin/latency/waterfall"
+                                     "?recent=4", headers=hdrs) as r:
+                        out["auth"] = (r.status, await r.json())
+                    async with s.get(
+                            f"{base}/admin/latency/waterfall") as r:
+                        out["anon"] = r.status
+                return out
+            finally:
+                await controller.stop()
+                for inv in invokers:
+                    await inv.stop()
+
+        out = asyncio.run(go())
+        assert out["anon"] == 401  # auth-gated like the other admin planes
+        status, body = out["auth"]
+        assert status == 200
+        assert body["enabled"] and body["finished"] >= 6
+        assert body["stages"] == list(STAGES)
+        per_stage = {s["stage"]: s for s in body["per_stage"]}
+        assert per_stage["publish_enqueue"]["count"] >= 6
+        assert per_stage["publish_enqueue"]["p50_ms"] is not None
+        assert body["budget"]["coverage_ratio"] == pytest.approx(1.0,
+                                                                 abs=0.25)
+        assert body["tail"]["dominant"]
+        assert len(body["recent"]) == 4
+        assert body["slowest"]
+        # slowest rows join back to the placement flight recorder
+        joined = [r for r in body["slowest"] if "placement" in r]
+        assert joined, "no slowest row joined to the flight recorder"
+        assert "queue_depth" in joined[0]["placement"]
+
+
+class TestLoadgen:
+    def test_make_schedule_poisson_and_constant(self):
+        from tools.loadgen import make_schedule
+        offs = make_schedule(100.0, 500, dist="poisson", seed=3)
+        assert len(offs) == 500
+        assert offs == sorted(offs)
+        # mean inter-arrival ~ 1/rate
+        mean_gap = offs[-1] / len(offs)
+        assert mean_gap == pytest.approx(0.01, rel=0.25)
+        const = make_schedule(100.0, 10, dist="constant")
+        assert const == pytest.approx([i / 100.0 for i in range(10)])
+        assert make_schedule(0, 10) == [] and make_schedule(10, 0) == []
+
+    def test_open_loop_measures_from_schedule(self):
+        """Coordinated-omission correctness: a stalled system's queueing
+        delay lands in the samples. `one` serializes on a lock with 20 ms
+        holds while arrivals come every 5 ms — a closed loop would report
+        ~20 ms, the open loop must show the queue ramp."""
+        from tools.loadgen import make_schedule, open_loop
+
+        lock = asyncio.Lock()
+
+        async def one(i, sched_ns):
+            async with lock:
+                await asyncio.sleep(0.02)
+            return True
+
+        async def go():
+            return await open_loop(one, make_schedule(
+                200.0, 10, dist="constant"))
+
+        row = asyncio.run(go())
+        assert row["completed"] == 10 and row["errors"] == 0
+        # the last arrival queues behind ~9 predecessors: ~besides its own
+        # 20 ms service it waited ~150+ ms measured from ITS schedule
+        assert row["p99_ms"] > 100.0
+        assert row["p50_ms"] > 40.0
+
+    def test_open_loop_counts_errors(self):
+        from tools.loadgen import make_schedule, open_loop
+
+        async def one(i, sched_ns):
+            if i % 2:
+                raise RuntimeError("boom")
+            return True
+
+        row = asyncio.run(open_loop(one, make_schedule(
+            500.0, 10, dist="constant")))
+        assert row["errors"] == 5 and row["completed"] == 5
+
+    def test_sustainable_verdict(self):
+        from tools.loadgen import sustainable
+        ok = {"completed": 100, "errors": 0, "unfinished": 0,
+              "p99_ms": 50.0, "fire_lag_max_ms": 2.0}
+        assert sustainable(ok)
+        assert not sustainable({**ok, "p99_ms": 5000.0})
+        assert not sustainable({**ok, "errors": 5})
+        assert not sustainable({**ok, "unfinished": 10})
+        assert not sustainable({**ok, "fire_lag_max_ms": 500.0})
+        assert not sustainable({**ok, "completed": 0})
