@@ -51,6 +51,19 @@ class ThrottleRejectRequest(EntitlementException):
     status = 429
 
 
+def rate_limit_message(description: str) -> str:
+    """The 429 body for a rate rejection — ONE copy shared by the serial
+    path and the batched AdmissionPlane (clients key on this text, and the
+    batched path's parity contract includes it verbatim)."""
+    return ("Too many requests in the last minute (count: exceeded, "
+            f"allowed: {description}).")
+
+
+CONCURRENT_LIMIT_MESSAGE = ("Too many concurrent requests in flight "
+                            "(count: exceeded, allowed: concurrent "
+                            "invocations).")
+
+
 class RateThrottler:
     """Sliding one-minute window counter per namespace (ref
     RateThrottler.scala — the reference uses a rolling minute bucket)."""
@@ -60,9 +73,13 @@ class RateThrottler:
         self.default_per_minute = default_per_minute
         self._events: Dict[str, deque] = {}
 
-    def check(self, namespace_id: str, limit_override: Optional[int] = None) -> bool:
+    def check(self, namespace_id: str, limit_override: Optional[int] = None,
+              now: Optional[float] = None) -> bool:
+        """`now` (monotonic seconds) defaults to the call time; the batched
+        admission plane's parity fuzz pins it so serial and vectorized
+        decisions are compared at identical clocks."""
         limit = limit_override if limit_override is not None else self.default_per_minute
-        now = time.monotonic()
+        now = time.monotonic() if now is None else now
         q = self._events.setdefault(namespace_id, deque())
         while q and q[0] <= now - 60.0:
             q.popleft()
@@ -96,11 +113,21 @@ class LocalEntitlementProvider:
                  concurrent_invocations: int = 30,
                  fires_per_minute: int = 60,
                  allowed_kinds: Optional[set] = None,
-                 metrics=None, event_producer=None):
+                 metrics=None, event_producer=None,
+                 admission_config=None):
         self.load_balancer = load_balancer
         self.metrics = metrics
         self.event_producer = event_producer  # `events` topic (throttle events)
         self._grants: Dict[str, set] = {}
+        # batched admission: concurrent ACTIVATE throttle checks coalesce
+        # into one vectorized pass (controller/admission.py). Off
+        # (CONFIG_whisk_admission_batch_enabled=false) keeps the serial
+        # _check_throttles path bit-exact with the pre-batching behavior.
+        from .admission import AdmissionBatchConfig, AdmissionPlane
+        adm_cfg = (admission_config if admission_config is not None
+                   else AdmissionBatchConfig.from_env())
+        self.admission: Optional[AdmissionPlane] = (
+            AdmissionPlane(self, adm_cfg) if adm_cfg.enabled else None)
         cluster = max(1, getattr(load_balancer, "cluster_size", 1) or 1)
         per_instance = lambda n: max(1, int(n / cluster * self.OVERCOMMIT)) \
             if cluster > 1 else n
@@ -143,7 +170,13 @@ class LocalEntitlementProvider:
         if waterfall_ctx is not None:
             ActivationWaterfall.stamp_ctx(waterfall_ctx, STAGE_ENTITLE)
         if throttle and right == ACTIVATE:
-            self._check_throttles(identity, is_trigger_fire)
+            if self.admission is not None:
+                # batched path: this check coalesces with concurrent
+                # arrivals and resolves from one vectorized flush (same
+                # decisions, same exceptions as the serial path)
+                await self.admission.check_throttles(identity, is_trigger_fire)
+            else:
+                self._check_throttles(identity, is_trigger_fire)
             if waterfall_ctx is not None:
                 ActivationWaterfall.stamp_ctx(waterfall_ctx, STAGE_THROTTLE)
 
@@ -154,20 +187,16 @@ class LocalEntitlementProvider:
             if not self.fire_rate.check(ns_id, limits.fires_per_minute):
                 self._throttle_event("TimedRateLimit", identity)
                 raise ThrottleRejectRequest(
-                    "Too many requests in the last minute (count: exceeded, "
-                    "allowed: trigger fires per minute).")
+                    rate_limit_message(self.fire_rate.description))
         else:
             if not self.invoke_rate.check(ns_id, limits.invocations_per_minute):
                 self._throttle_event("TimedRateLimit", identity)
                 raise ThrottleRejectRequest(
-                    "Too many requests in the last minute (count: exceeded, "
-                    "allowed: invocations per minute).")
+                    rate_limit_message(self.invoke_rate.description))
             if self.load_balancer is not None and \
                     not self.concurrent.check(ns_id, limits.concurrent_invocations):
                 self._throttle_event("ConcurrentRateLimit", identity)
-                raise ThrottleRejectRequest(
-                    "Too many concurrent requests in flight (count: exceeded, "
-                    "allowed: concurrent invocations).")
+                raise ThrottleRejectRequest(CONCURRENT_LIMIT_MESSAGE)
 
     def check_kind(self, identity: Identity, kind: str) -> None:
         """Kind whitelist (ref KindRestrictor, Entitlement.scala:197-211)."""

@@ -170,7 +170,13 @@ class CommonLoadBalancer(LoadBalancer):
         self.controller = controller_instance
         self.logger = logger
         self.metrics = metrics or MetricEmitter()
-        self.producer = messaging_provider.get_producer()
+        # the dispatch fan-out producer rides the coalescing wrapper
+        # (messaging/coalesce.py): one readback wave's N invoker sends ship
+        # as micro-batches (one frame + one ack on the TCP bus) instead of
+        # N serialized round trips. CONFIG_whisk_bus_coalesce_enabled=false
+        # restores the raw serial producer bit-exactly.
+        from ...messaging.coalesce import maybe_coalesce
+        self.producer = maybe_coalesce(messaging_provider.get_producer())
         self.activation_slots: Dict[str, ActivationEntry] = {}
         self.activations_per_namespace: Dict[str, int] = {}
         self._total = 0
@@ -519,6 +525,9 @@ class CommonLoadBalancer(LoadBalancer):
     async def close(self) -> None:
         if self._ack_feed:
             await self._ack_feed.stop()
+        # flush any coalescing window still holding queued sends, then
+        # release the producer's transport (previously leaked on the TCP bus)
+        await self.producer.close()
         for entry in list(self.activation_slots.values()):
             if entry.timeout_task:
                 entry.timeout_task.cancel()

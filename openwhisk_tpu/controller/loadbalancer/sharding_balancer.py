@@ -15,6 +15,8 @@ from typing import List, Optional
 from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
 from ...messaging.message import ActivationMessage
 from ...models.sharding_policy import ShardingPolicyState, release, schedule
+from ...messaging.coalesce import export_coalesce_gauges
+from ...messaging.tcp import export_bus_gauges
 from ...utils.tracing import export_tracing_gauges, trace_id_of
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth, LoadBalancerException)
 from .flight_recorder import occupancy_json
@@ -52,6 +54,9 @@ class ShardingBalancer(CommonLoadBalancer):
         # behaves identically should this balancer run beside a device
         self.profiler.refresh_memory(self.metrics)
         export_tracing_gauges(self.metrics)
+        # bus-client health rides the same cadence (messaging/{coalesce,tcp})
+        export_coalesce_gauges(self.metrics)
+        export_bus_gauges(self.metrics)
 
     async def start(self) -> None:
         self.start_ack_feed()

@@ -55,6 +55,8 @@ from ...ops.placement import (PlacementState, RequestBatch, init_state,
 from ...ops.throttle import init_buckets
 from ...utils.config import load_config
 from ...utils.ring_buffer import ColumnRing
+from ...messaging.coalesce import export_coalesce_gauges
+from ...messaging.tcp import export_bus_gauges
 from ...utils.tracing import export_tracing_gauges, trace_id_of
 from ...utils.waterfall import (STAGE_BATCH_ASSEMBLE, STAGE_DEVICE_DISPATCH,
                                 STAGE_DEVICE_READBACK, STAGE_PUBLISH_ENQUEUE)
@@ -94,6 +96,14 @@ class PlacementPathConfig:
     donate_state: bool = True
     ring_assembly: bool = True
     prewarm: bool = True
+    #: adaptive_window: under arrival pressure, trade a bounded
+    #: accumulation delay (ADAPTIVE_WINDOW_MS) for bigger micro-batches
+    #: instead of eager per-arrival dispatch. An idle or slow-trickle
+    #: balancer keeps the eager fast path (zero added latency); a loaded
+    #: one stops paying one fixed-cost device dispatch per 1-3 arrivals —
+    #: the dominant per-activation tax at high open-loop rates on the CPU
+    #: twin. Off = the exact pre-coalescing eager/window policy.
+    adaptive_window: bool = True
 
 
 def _next_pow2(n: int) -> int:
@@ -225,6 +235,7 @@ class TpuBalancer(CommonLoadBalancer):
                  donate_state: Optional[bool] = None,
                  ring_assembly: Optional[bool] = None,
                  prewarm: Optional[bool] = None,
+                 adaptive_window: Optional[bool] = None,
                  profiler=None, anomaly=None, waterfall=None):
         super().__init__(messaging_provider, controller_instance, logger,
                          metrics, profiler=profiler, anomaly=anomaly,
@@ -249,6 +260,13 @@ class TpuBalancer(CommonLoadBalancer):
                               else path_cfg.ring_assembly)
         self.prewarm = (prewarm if prewarm is not None
                         else path_cfg.prewarm)
+        self.adaptive_window = (adaptive_window if adaptive_window is not None
+                                else path_cfg.adaptive_window)
+        #: publish inter-arrival EWMA (ms) — the adaptive window's pressure
+        #: signal. Initialized sparse so a fresh balancer is eager.
+        self._gap_ewma_ms = 1000.0
+        self._last_gap_ms = 1e9
+        self._last_pub_t = time.monotonic()
         self.managed_fraction = managed_fraction
         self.blackbox_fraction = blackbox_fraction
         self.batch_window = batch_window
@@ -344,6 +362,10 @@ class TpuBalancer(CommonLoadBalancer):
         # backends without memory_stats, e.g. CPU)
         self.profiler.refresh_memory(self.metrics)
         export_tracing_gauges(self.metrics)
+        # bus-client health rides the same cadence: coalescing batch sizes
+        # and consumer reconnects (messaging/{coalesce,tcp}.py)
+        export_coalesce_gauges(self.metrics)
+        export_bus_gauges(self.metrics)
 
     # -- device state ------------------------------------------------------
     def _resolve_kernel(self) -> str:
@@ -912,7 +934,9 @@ class TpuBalancer(CommonLoadBalancer):
         # digest), the activation/action ids for the decision row, and the
         # trace id (exemplar plumbing on OpenMetrics scrapes)
         aid_str = msg.activation_id.asString
-        entry = (req, fut, slot_key, time.monotonic(),
+        t_now = time.monotonic()
+        self._note_arrival(t_now)
+        entry = (req, fut, slot_key, t_now,
                  aid_str, fqn_str,
                  trace_id_of(msg.trace_context))
         # waterfall: the activation is now IN the balancer's queue — the
@@ -933,9 +957,14 @@ class TpuBalancer(CommonLoadBalancer):
         # slow/tunneled device round trips serialize, so splitting an
         # arrival wave into eager sub-batches multiplies wire time —
         # measured RTT (EWMA of the readback histogram) picks the policy.
+        # Under arrival PRESSURE (_coalesce_window_s > 0) eager dispatch is
+        # the tax, not the cure: per-arrival steps ship batches of 1-3 and
+        # the fixed dispatch cost dominates the loop — hold the window and
+        # let the batch fill instead.
         if not ((len(self._pending) >= self.max_batch
                  or (self._inflight_steps == 0
-                     and self._rtt_ewma_ms < self.RTT_FAST_MS))
+                     and self._rtt_ewma_ms < self.RTT_FAST_MS
+                     and self._coalesce_window_s() == 0.0))
                 and self._try_flush_now()):
             self._arm_flush(urgent=len(self._pending) >= self.max_batch)
         try:
@@ -1121,17 +1150,52 @@ class TpuBalancer(CommonLoadBalancer):
         self._recompute_partitions()
 
     # -- the device step ---------------------------------------------------
+
+    #: adaptive dispatch window (see PlacementPathConfig.adaptive_window):
+    #: the bounded accumulation delay a loaded balancer trades for batch
+    #: size, and the minimum batch a window must be expected to gather to
+    #: be worth holding (below that, eager dispatch wins on latency with
+    #: nothing to amortize)
+    ADAPTIVE_WINDOW_MS = 8.0
+    ADAPTIVE_MIN_BATCH = 4
+
+    def _note_arrival(self, now: float) -> None:
+        """Track the publish inter-arrival EWMA — the pressure signal the
+        adaptive window switches on. One subtract + one blend per publish."""
+        gap_ms = (now - self._last_pub_t) * 1e3
+        self._last_pub_t = now
+        self._last_gap_ms = gap_ms
+        self._gap_ewma_ms = min(0.9 * self._gap_ewma_ms + 0.1 * gap_ms,
+                                1000.0)
+
+    def _coalesce_window_s(self) -> float:
+        """> 0 when arrival pressure says windowed batching beats eager
+        dispatch: the EWMA predicts at least ADAPTIVE_MIN_BATCH arrivals
+        inside one window, and the instantaneous gap confirms traffic is
+        still flowing (a lone request after a burst must not inherit the
+        burst's window)."""
+        if (self.adaptive_window
+                and self._gap_ewma_ms * self.ADAPTIVE_MIN_BATCH
+                <= self.ADAPTIVE_WINDOW_MS
+                and self._last_gap_ms <= self.ADAPTIVE_WINDOW_MS):
+            return self.ADAPTIVE_WINDOW_MS / 1e3
+        return 0.0
+
     def _arm_flush(self, urgent: bool = False) -> None:
         if getattr(self, "_closing", False):
             return  # close() drains queued releases host-side itself
+        window = self._coalesce_window_s()
         # idle fast path: with no step in flight there is nothing to batch
         # WITH — waiting out the window would only add latency (the window
-        # exists to amortize a round trip that is already being paid)
-        if self._inflight_steps == 0 and self._pending:
+        # exists to amortize a round trip that is already being paid).
+        # Under arrival pressure the adaptive window overrides: the batch
+        # forming over the next few ms IS the thing to batch with.
+        if self._inflight_steps == 0 and self._pending and window == 0.0:
             urgent = True
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_event_loop().create_task(
-                self._flush_later(0 if urgent else self.batch_window))
+                self._flush_later(0 if urgent
+                                  else (window or self.batch_window)))
 
     async def _flush_later(self, delay: float) -> None:
         # loop INSIDE the task until drained: a tail call to _arm_flush would
@@ -1143,7 +1207,7 @@ class TpuBalancer(CommonLoadBalancer):
                 await self._device_step()
             if not (self._pending or self._releases or self._health_updates):
                 return
-            delay = self.batch_window
+            delay = self._coalesce_window_s() or self.batch_window
 
     #: request-tuple field indices (row order of the packed matrix)
     R_NEED_MB, R_CONC_SLOT, R_MAX_CONC = 4, 5, 6

@@ -45,6 +45,13 @@ class ArtifactActivationStore(ActivationStore):
         self._batcher: Batcher = Batcher(self._write_batch, batch_size=batch_size)
 
     async def _write_batch(self, activations: List[WhiskActivation]) -> List[str]:
+        # stores with a native bulk write take the whole coalesced batch in
+        # one call (one lock/round trip for N records) — without it the
+        # batcher still amortizes scheduling but the backend sees N puts
+        put_many = getattr(self.store_backend, "put_many", None)
+        if put_many is not None:
+            return await put_many([(a.docid, a.to_document())
+                                   for a in activations])
         out = []
         for a in activations:
             out.append(await self.store_backend.put(a.docid, a.to_document()))

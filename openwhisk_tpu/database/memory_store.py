@@ -16,24 +16,36 @@ class MemoryArtifactStore(ArtifactStore):
         self._attachments: Dict[str, Dict[str, Tuple[str, bytes]]] = {}
         self._lock = asyncio.Lock()
 
+    def _put_locked(self, doc_id: str, doc: Dict[str, Any],
+                    rev: Optional[str] = None) -> str:
+        existing = self._docs.get(doc_id)
+        if existing is not None:
+            cur = existing["_rev"]
+            if rev is None or rev != cur:
+                raise DocumentConflict(f"document {doc_id!r} update conflict")
+            new_rev = f"{int(cur.split('-')[0]) + 1}-mem"
+        else:
+            if rev is not None:
+                raise DocumentConflict(f"document {doc_id!r} does not exist at rev {rev}")
+            new_rev = "1-mem"
+        stored = copy.deepcopy(doc)
+        stored["_id"] = doc_id
+        stored["_rev"] = new_rev
+        self._docs[doc_id] = stored
+        return new_rev
+
     async def put(self, doc_id: str, doc: Dict[str, Any],
                   rev: Optional[str] = None) -> str:
         async with self._lock:
-            existing = self._docs.get(doc_id)
-            if existing is not None:
-                cur = existing["_rev"]
-                if rev is None or rev != cur:
-                    raise DocumentConflict(f"document {doc_id!r} update conflict")
-                new_rev = f"{int(cur.split('-')[0]) + 1}-mem"
-            else:
-                if rev is not None:
-                    raise DocumentConflict(f"document {doc_id!r} does not exist at rev {rev}")
-                new_rev = "1-mem"
-            stored = copy.deepcopy(doc)
-            stored["_id"] = doc_id
-            stored["_rev"] = new_rev
-            self._docs[doc_id] = stored
-            return new_rev
+            return self._put_locked(doc_id, doc, rev)
+
+    async def put_many(self, docs: List[Tuple[str, Dict[str, Any]]]) -> List[str]:
+        """Bulk insert for the activation-record batcher: one lock acquire
+        for N new documents, same per-document conflict semantics as put()
+        (a mid-batch conflict fails the whole batch, exactly like the
+        serial loop the batcher would otherwise run)."""
+        async with self._lock:
+            return [self._put_locked(doc_id, doc) for doc_id, doc in docs]
 
     async def get(self, doc_id: str) -> Dict[str, Any]:
         doc = self._docs.get(doc_id)

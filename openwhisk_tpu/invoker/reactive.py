@@ -47,7 +47,12 @@ class InvokerReactive:
         self.logger = logger
         self.metrics = metrics
         self.ping_interval = ping_interval
-        self.producer = messaging_provider.get_producer()
+        # completion acks, activation events and health pings all ride the
+        # coalescing wrapper: under load the ack fan-in ships one frame per
+        # micro-batch instead of one bus round trip per completion
+        # (CONFIG_whisk_bus_coalesce_enabled=false restores serial sends)
+        from ..messaging.coalesce import maybe_coalesce
+        self.producer = maybe_coalesce(messaging_provider.get_producer())
 
         prewarm = []
         for manifest, cell in ExecManifest.runtimes().stem_cells():
@@ -119,6 +124,9 @@ class InvokerReactive:
         if self._feed:
             await self._feed.stop()
         await self.pool.shutdown()
+        # drain any coalescing window still holding queued acks/events and
+        # release the producer transport
+        await self.producer.close()
         await self.factory.cleanup()
 
     # -- activation processing (ref :213-307) -------------------------------

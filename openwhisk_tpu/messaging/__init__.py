@@ -2,6 +2,8 @@ from .message import (AcknowledgementMessage, ActivationMessage,
                       CombinedCompletionAndResultMessage, CompletionMessage,
                       EventMessage, Message, PingMessage, ResultMessage,
                       parse_ack)
+from .coalesce import (BusCoalesceConfig, CoalescingProducer,
+                       export_coalesce_gauges, maybe_coalesce)
 from .connector import MessageConsumer, MessageFeed, MessageProducer, MessagingProvider
 from .memory import MemoryMessagingProvider
 

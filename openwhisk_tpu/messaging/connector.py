@@ -32,6 +32,16 @@ class MessageProducer:
         """Send a Message (or raw bytes) to a topic."""
         raise NotImplementedError
 
+    async def send_many(self, items) -> None:
+        """Ship a pre-serialized micro-batch `[(topic, payload_bytes, msg)]`
+        (msg is the original Message for waterfall stamping, or None).
+        Backends with a native batch op (one frame + one ack for N
+        messages: the TCP bus `pubN`, Kafka's client-side batching)
+        override this; the default degrades to sequential sends — serial
+        semantics, so the CoalescingProducer is safe over any provider."""
+        for topic, payload, msg in items:
+            await self.send(topic, msg if msg is not None else payload)
+
     @property
     def sent_count(self) -> int:
         return 0

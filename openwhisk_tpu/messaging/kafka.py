@@ -57,6 +57,25 @@ class KafkaProducer(MessageProducer):
         from .connector import stamp_produce
         stamp_produce(msg)  # waterfall produce edge (broker-acknowledged)
 
+    async def send_many(self, items) -> None:
+        """Coalesced produce: enqueue the whole micro-batch into the
+        client's accumulator first, then await the acks together — the
+        client packs them into shared produce requests (its batching is
+        record-level), so N messages cost one round of broker round trips
+        instead of N sequential send_and_wait barriers."""
+        if not self._started:
+            await self._producer.start()
+            self._started = True
+        futs = [await self._producer.send(topic, bytes(payload))
+                for (topic, payload, _m) in items]
+        import asyncio
+        await asyncio.gather(*futs)
+        self._sent += len(items)
+        from .connector import stamp_produce
+        for _topic, _payload, m in items:
+            if m is not None:
+                stamp_produce(m)  # produce edge per message, acks gathered
+
     async def close(self) -> None:
         if self._started:
             await self._producer.stop()

@@ -75,19 +75,42 @@ class MemoryProducer(MessageProducer):
     def sent_count(self) -> int:
         return self._sent
 
+    def _append_locked(self, t: _Topic, payload) -> None:
+        """Fan one payload out to every group (t.cond must be held)."""
+        off = next(t.offset)
+        for q in t.groups.values():
+            q.append((off, bytes(payload)))
+        if not t.groups:
+            # retain for the first group to subscribe (queue semantics)
+            t.queue_for("__default__").append((off, bytes(payload)))
+        self._sent += 1
+
     async def send(self, topic: str, msg) -> None:
         payload = msg if isinstance(msg, (bytes, bytearray)) else msg.serialize()
         t = self.bus.topic(topic)
-        off = next(t.offset)
         async with t.cond:
-            for q in t.groups.values():
-                q.append((off, bytes(payload)))
-            if not t.groups:
-                # retain for the first group to subscribe (queue semantics)
-                t.queue_for("__default__").append((off, bytes(payload)))
-            self._sent += 1
+            self._append_locked(t, payload)
             t.cond.notify_all()
         stamp_produce(msg)  # waterfall produce edge
+
+    async def send_many(self, items) -> None:
+        """Coalesced produce: one condition acquire + one notify per TOPIC
+        per micro-batch instead of per message (the controller's readback
+        fan-out spreads one batch over N invoker topics; the ack path is a
+        single topic). Order within a topic is arrival order, exactly like
+        serial sends."""
+        by_topic: dict = {}
+        for topic, payload, msg in items:
+            by_topic.setdefault(topic, []).append((payload, msg))
+        for topic, group in by_topic.items():
+            t = self.bus.topic(topic)
+            async with t.cond:
+                for payload, _m in group:
+                    self._append_locked(t, payload)
+                t.cond.notify_all()
+            for _p, m in group:
+                if m is not None:
+                    stamp_produce(m)  # waterfall produce edge (per message)
 
 
 class MemoryConsumer(MessageConsumer):

@@ -1,0 +1,272 @@
+"""Batched front-door admission (controller/admission.py): bit-parity of
+the vectorized host twin against the serial RateThrottler, and the
+AdmissionPlane's coalesced check semantics (ISSUE 8)."""
+import asyncio
+import dataclasses
+import random
+from collections import deque
+
+import pytest
+
+from openwhisk_tpu.controller.admission import (AdmissionBatchConfig,
+                                                AdmissionPlane,
+                                                rate_admit_batch)
+from openwhisk_tpu.controller.entitlement import (ACTIVATE,
+                                                  LocalEntitlementProvider,
+                                                  RateThrottler,
+                                                  ThrottleRejectRequest)
+from openwhisk_tpu.core.entity import Identity
+from openwhisk_tpu.core.entity.identity import UserLimits
+
+BATCH_ON = AdmissionBatchConfig(enabled=True, window_ms=0.5, max_batch=256)
+BATCH_OFF = AdmissionBatchConfig(enabled=False)
+
+
+def _ident(name: str, **limits) -> Identity:
+    return dataclasses.replace(Identity.generate(name),
+                               limits=UserLimits(**limits))
+
+
+class TestRateAdmitParity:
+    """The vectorized pass must make EXACTLY the serial decisions — same
+    admit/reject vector, same deque state afterward — across randomized
+    namespace bursts, per-namespace limit overrides, and window rollover."""
+
+    def test_fuzz_parity_with_serial(self):
+        rng = random.Random(8)
+        serial = RateThrottler("fuzz-serial", default_per_minute=7)
+        batched = RateThrottler("fuzz-batched", default_per_minute=7)
+        namespaces = [f"ns{i}" for i in range(6)]
+        # per-namespace override (None = platform default) — uniform within
+        # a namespace, like a real identity record
+        overrides = {ns: rng.choice([None, 1, 3, 12]) for ns in namespaces}
+        now = 100.0
+        for _round in range(60):
+            # advance time; occasionally jump past the rolling minute so
+            # expiry/rollover paths are exercised
+            now += rng.choice([0.001, 0.05, 1.0, 61.0])
+            batch_ns = [rng.choice(namespaces)
+                        for _ in range(rng.randint(1, 24))]
+            limits = [overrides[ns] for ns in batch_ns]
+            expect = [serial.check(ns, lim, now=now)
+                      for ns, lim in zip(batch_ns, limits)]
+            got = rate_admit_batch(batched, batch_ns, limits, now=now)
+            assert list(got) == expect, f"round {_round}: {batch_ns}"
+            for ns in namespaces:
+                assert list(serial._events.get(ns, deque())) == \
+                    list(batched._events.get(ns, deque())), ns
+
+    def test_heterogeneous_limits_replay_serially(self):
+        """Mixed per-request limits inside ONE namespace break the rank
+        shortcut (an early rejection consumes nothing): limits [1,1,3]
+        with one token spent must reject, reject, ADMIT — rank math alone
+        would reject the third."""
+        serial = RateThrottler("s", default_per_minute=99)
+        batched = RateThrottler("b", default_per_minute=99)
+        now = 10.0
+        assert serial.check("ns", 99, now=now)      # one event in the window
+        assert batched.check("ns", 99, now=now)
+        limits = [1, 1, 3]
+        expect = [serial.check("ns", lim, now=now) for lim in limits]
+        assert expect == [False, False, True]
+        got = rate_admit_batch(batched, ["ns"] * 3, limits, now=now)
+        assert list(got) == expect
+        assert list(serial._events["ns"]) == list(batched._events["ns"])
+
+    def test_heterogeneous_fuzz(self):
+        """Randomized mixed-override batches (the serial-replay fallback
+        arm) stay bit-par with the serial loop."""
+        rng = random.Random(31)
+        serial = RateThrottler("s", default_per_minute=5)
+        batched = RateThrottler("b", default_per_minute=5)
+        now = 50.0
+        for _round in range(40):
+            now += rng.choice([0.01, 0.5, 61.0])
+            batch = [(rng.choice(["a", "b"]), rng.choice([None, 1, 2, 8]))
+                     for _ in range(rng.randint(1, 16))]
+            expect = [serial.check(ns, lim, now=now) for ns, lim in batch]
+            got = rate_admit_batch(batched, [ns for ns, _ in batch],
+                                   [lim for _, lim in batch], now=now)
+            assert list(got) == expect
+        for ns in ("a", "b"):
+            assert list(serial._events.get(ns, deque())) == \
+                list(batched._events.get(ns, deque()))
+
+    def test_empty_batch(self):
+        t = RateThrottler("e", 5)
+        assert rate_admit_batch(t, [], [], now=1.0).shape == (0,)
+
+
+class _FakeBalancer:
+    def __init__(self, active=0):
+        self.active = active
+        self.cluster_size = 1
+
+    def active_activations_for(self, ns):
+        return self.active
+
+
+class TestAdmissionPlane:
+    def test_burst_admits_exactly_the_limit(self):
+        """A concurrent burst over the per-minute limit: exactly `limit`
+        admits, the rest raise the serial path's ThrottleRejectRequest."""
+        async def go():
+            p = LocalEntitlementProvider(invocations_per_minute=5,
+                                         admission_config=BATCH_ON)
+            ident = _ident("guest")
+            results = await asyncio.gather(
+                *[p.check(ident, ACTIVATE, "guest", throttle=True)
+                  for _ in range(12)], return_exceptions=True)
+            return results
+
+        results = asyncio.run(go())
+        admitted = [r for r in results if r is None]
+        rejected = [r for r in results if isinstance(r, ThrottleRejectRequest)]
+        assert len(admitted) == 5 and len(rejected) == 7
+        assert "invocations per minute" in str(rejected[0])
+
+    def test_concurrency_throttle_via_plane(self):
+        async def go():
+            p = LocalEntitlementProvider(load_balancer=_FakeBalancer(active=30),
+                                         invocations_per_minute=100,
+                                         concurrent_invocations=30,
+                                         admission_config=BATCH_ON)
+            with pytest.raises(ThrottleRejectRequest) as ei:
+                await p.check(_ident("guest"), ACTIVATE, "guest",
+                              throttle=True)
+            return str(ei.value)
+
+        assert "concurrent" in asyncio.run(go())
+
+    def test_concurrency_intra_batch_accounting(self):
+        """A coalesced burst cannot overshoot the concurrency limit: each
+        admission in a flush counts against the limit for later
+        batch-mates (deliberately STRICTER than the serial race, where N
+        arrivals between counter updates all read the same in-flight
+        count and all pass)."""
+        async def go():
+            p = LocalEntitlementProvider(load_balancer=_FakeBalancer(active=2),
+                                         invocations_per_minute=1000,
+                                         concurrent_invocations=5,
+                                         admission_config=BATCH_ON)
+            ident = _ident("guest")
+            results = await asyncio.gather(
+                *[p.check(ident, ACTIVATE, "guest", throttle=True)
+                  for _ in range(12)], return_exceptions=True)
+            return results
+
+        results = asyncio.run(go())
+        admitted = sum(r is None for r in results)
+        rejected = [r for r in results if isinstance(r, ThrottleRejectRequest)]
+        assert admitted == 3  # limit 5 - 2 already active
+        assert len(rejected) == 9
+        assert "concurrent" in str(rejected[0])
+
+    def test_trigger_fires_use_fire_throttler(self):
+        async def go():
+            p = LocalEntitlementProvider(invocations_per_minute=1,
+                                         fires_per_minute=4,
+                                         admission_config=BATCH_ON)
+            ident = _ident("guest")
+            fires = await asyncio.gather(
+                *[p.check(ident, ACTIVATE, "guest", throttle=True,
+                          is_trigger_fire=True) for _ in range(6)],
+                return_exceptions=True)
+            return fires
+
+        fires = asyncio.run(go())
+        rejected = [r for r in fires if isinstance(r, ThrottleRejectRequest)]
+        assert len(rejected) == 2
+        assert "trigger fires per minute" in str(rejected[0])
+
+    def test_per_user_override_honored(self):
+        async def go():
+            p = LocalEntitlementProvider(invocations_per_minute=100,
+                                         admission_config=BATCH_ON)
+            ident = _ident("guest", invocations_per_minute=2)
+            return await asyncio.gather(
+                *[p.check(ident, ACTIVATE, "guest", throttle=True)
+                  for _ in range(5)], return_exceptions=True)
+
+        results = asyncio.run(go())
+        assert sum(r is None for r in results) == 2
+
+    def test_off_switch_is_serial_path(self, monkeypatch):
+        """enabled=false keeps the provider on _check_throttles — no plane,
+        no awaitable coalescing, today's bit-exact serial behavior."""
+        p = LocalEntitlementProvider(admission_config=BATCH_OFF)
+        assert p.admission is None
+        monkeypatch.setenv("CONFIG_whisk_admission_batch_enabled", "false")
+        p2 = LocalEntitlementProvider()
+        assert p2.admission is None
+
+        async def go():
+            prov = LocalEntitlementProvider(invocations_per_minute=3,
+                                            admission_config=BATCH_OFF)
+            ident = _ident("guest")
+            out = []
+            for _ in range(5):
+                try:
+                    await prov.check(ident, ACTIVATE, "guest", throttle=True)
+                    out.append(True)
+                except ThrottleRejectRequest:
+                    out.append(False)
+            return out
+
+        assert asyncio.run(go()) == [True, True, True, False, False]
+
+    def test_batched_matches_serial_decisions(self):
+        """The same scripted arrival sequence admits identically through
+        the plane and through the serial path (sequential submission, so
+        ordering is deterministic on both sides)."""
+        async def run(cfg):
+            p = LocalEntitlementProvider(invocations_per_minute=4,
+                                         admission_config=cfg)
+            ident = _ident("guest")
+            out = []
+            for _ in range(7):
+                try:
+                    await p.check(ident, ACTIVATE, "guest", throttle=True)
+                    out.append(True)
+                except ThrottleRejectRequest:
+                    out.append(False)
+            return out
+
+        assert asyncio.run(run(BATCH_ON)) == asyncio.run(run(BATCH_OFF))
+
+    def test_plane_counts_batches(self):
+        async def go():
+            p = LocalEntitlementProvider(invocations_per_minute=100,
+                                         admission_config=BATCH_ON)
+            ident = _ident("guest")
+            await asyncio.gather(
+                *[p.check(ident, ACTIVATE, "guest", throttle=True)
+                  for _ in range(10)])
+            return p.admission.batches, p.admission.checked
+
+        batches, checked = asyncio.run(go())
+        assert checked == 10
+        # a concurrent gather coalesces: far fewer flushes than checks
+        assert 1 <= batches <= 5
+
+    def test_throttle_events_emitted(self):
+        class _Metrics:
+            def __init__(self):
+                self.counts = {}
+
+            def counter(self, name, n=1):
+                self.counts[name] = self.counts.get(name, 0) + n
+
+        async def go():
+            m = _Metrics()
+            p = LocalEntitlementProvider(invocations_per_minute=1,
+                                         metrics=m,
+                                         admission_config=BATCH_ON)
+            ident = _ident("guest")
+            await asyncio.gather(
+                *[p.check(ident, ACTIVATE, "guest", throttle=True)
+                  for _ in range(4)], return_exceptions=True)
+            return m.counts
+
+        counts = asyncio.run(go())
+        assert counts.get("controller_throttle_TimedRateLimit") == 3
