@@ -1054,6 +1054,144 @@ def _pipeline_speedup(repeats: int = 3, total: int = 1200,
         return None
 
 
+def _failover_downtime(rate: float = 128.0, duration: float = 2.0,
+                       n_invokers: int = 8) -> Optional[dict]:
+    """ISSUE 9 rider: the HA plane's headline number. Drive an open-loop
+    burst at a journaled active balancer, snapshot mid-burst, then
+    hard-kill it (journaling stops dead, crash semantics: only what the
+    fsync batches made durable survives) and promote a standby:
+    snapshot restore + deterministic journal-tail replay + first
+    successful placement. Reports the restore-path downtime — failure
+    DETECTION is deployment config (membership member_timeout_s, default
+    5 s) and is excluded, and said so, rather than baked into a number
+    that would just echo the timeout knob."""
+    import os
+    import tempfile
+
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.controller.loadbalancer.checkpoint import \
+        write_snapshot
+    from openwhisk_tpu.controller.loadbalancer.journal import PlacementJournal
+    from openwhisk_tpu.controller.loadbalancer.membership import \
+        MEMBER_TIMEOUT_S
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.transaction import TransactionId
+    from tools.loadgen import make_schedule
+
+    async def go() -> dict:
+        tmp = tempfile.mkdtemp(prefix="failover-bench-")
+        snap_path = os.path.join(tmp, "bal.snap")
+        jdir = os.path.join(tmp, "wal")
+        provider = MemoryMessagingProvider()
+        active = TpuBalancer(provider, ControllerInstanceId("0"),
+                             managed_fraction=1.0, blackbox_fraction=0.0,
+                             kernel="xla", prewarm=False)
+        active.attach_journal(PlacementJournal(jdir))
+        await active.start()
+        feeds, fleet_stop = await _echo_fleet(provider, n_invokers)
+        for _ in range(100):
+            if sum(active._healthy) >= n_invokers:
+                break
+            await asyncio.sleep(0.05)
+        actions = [_bench_action(f"fo{i}", memory=128) for i in range(4)]
+        ident = Identity.generate("guest")
+
+        def msg_for(a, instance="0"):
+            return ActivationMessage(
+                TransactionId(), a.fully_qualified_name, a.rev.rev, ident,
+                ActivationId.generate(), ControllerInstanceId(instance),
+                True, {})
+
+        async def one(bal, i, instance="0"):
+            a = actions[i % len(actions)]
+            try:
+                promise = await bal.publish(a, msg_for(a, instance))
+                await promise
+                return True
+            except Exception:  # noqa: BLE001 — a failed send is a sample
+                return False
+
+        # open-loop burst; snapshot at the halfway mark so the journal
+        # tail carries real post-snapshot work to replay
+        offsets = make_schedule(rate, max(1, int(rate * duration)), seed=5)
+        t0 = time.monotonic()
+        tasks = []
+        snapped = False
+        for i, off in enumerate(offsets):
+            now = time.monotonic() - t0
+            if off > now:
+                await asyncio.sleep(off - now)
+            if not snapped and off >= duration / 2:
+                write_snapshot(active, snap_path)
+                snapped = True
+            tasks.append(asyncio.ensure_future(one(active, i)))
+        if not snapped:
+            write_snapshot(active, snap_path)
+        done = await asyncio.gather(*tasks)
+        snapshot_age_ms = (time.monotonic() - t0 - duration / 2) * 1e3
+        # HARD KILL: journaling stops here; anything past the last durable
+        # fsync batch is lost, exactly as a SIGKILL would lose it
+        await asyncio.sleep(0.05)  # let the tail fsync land (linger_s)
+        lag_at_kill = active.journal.lag_batches
+        active.journal = None
+        t_kill = time.monotonic()
+
+        # standby promotion: restore + replay + first placement
+        standby = TpuBalancer(provider, ControllerInstanceId("1"),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              kernel="xla", prewarm=False)
+        journal = PlacementJournal(jdir)
+        t_r0 = time.monotonic()
+        import json as _json
+        with open(snap_path) as f:
+            snap_doc = _json.load(f)
+        standby.restore(snap_doc)
+        t_restored = time.monotonic()
+        stats = standby.replay_journal(
+            journal.records(int(snap_doc.get("journal_seq", 0))),
+            from_seq=int(snap_doc.get("journal_seq", 0)))
+        t_replayed = time.monotonic()
+        standby.set_leadership(2, True)
+        await standby.start()
+        first_ok = await one(standby, 0, instance="1")
+        t_first = time.monotonic()
+        await active.close()
+        await standby.close()
+        await fleet_stop()
+        for f in feeds:
+            await f.stop()
+        journal.close()
+        return {
+            "downtime_ms": round((t_first - t_kill) * 1e3, 1),
+            "restore_ms": round((t_restored - t_r0) * 1e3, 1),
+            "replay_ms": round((t_replayed - t_restored) * 1e3, 1),
+            "first_placement_ms": round((t_first - t_replayed) * 1e3, 1),
+            "replayed_records": stats["replayed"],
+            "replayed_batches": stats["batches"],
+            "replay_parity_mismatches": stats["parity_mismatches"],
+            "journal_lag_at_kill": lag_at_kill,
+            "snapshot_age_ms": round(snapshot_age_ms, 1),
+            "burst_completed": int(sum(done)),
+            "burst_offered": len(offsets),
+            "first_standby_placement_ok": bool(first_ok),
+            "offered_rate": rate,
+            "n_invokers": n_invokers,
+            "excludes_detection_window": True,
+            "detection_timeout_s_default": MEMBER_TIMEOUT_S,
+        }
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# failover_downtime failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _backend_unavailable(e: BaseException) -> bool:
     """True for the LAZY backend-init failure mode: the subprocess probe
     passed but the first dispatched op inside the measured run raised
@@ -1219,12 +1357,15 @@ def _run(args) -> Optional[dict]:
     repair_vs_scan = None
     pipeline_speedup = None
     bus_coalesce_speedup = None
+    failover_downtime = None
     if not args.quick:
         # the new headline first: the open-loop observatory (sustained
         # activations/s + the per-stage budget the next PR attacks)
         e2e_open_loop = _run_rider("_e2e_open_loop", _e2e_open_loop)
         bus_coalesce_speedup = _run_rider("_bus_coalesce_speedup",
                                           _bus_coalesce_speedup)
+        failover_downtime = _run_rider("_failover_downtime",
+                                       _failover_downtime)
         waterfall_overhead = _run_rider("_waterfall_overhead",
                                         _waterfall_overhead)
         repair_vs_scan = _run_rider("_repair_vs_scan", _repair_vs_scan)
@@ -1333,6 +1474,8 @@ def _run(args) -> Optional[dict]:
         out["e2e_open_loop"] = e2e_open_loop
     if bus_coalesce_speedup is not None:
         out["bus_coalesce_speedup"] = bus_coalesce_speedup
+    if failover_downtime is not None:
+        out["failover_downtime"] = failover_downtime
     if repair_vs_scan is not None:
         out["repair_vs_scan"] = repair_vs_scan
     if pipeline_speedup is not None:
@@ -1342,7 +1485,7 @@ def _run(args) -> Optional[dict]:
                      profiling_overhead, anomaly_overhead,
                      waterfall_overhead, e2e_open_loop,
                      repair_vs_scan, pipeline_speedup,
-                     bus_coalesce_speedup)):
+                     bus_coalesce_speedup, failover_downtime)):
         # a rider lost the device mid-run and re-ran on CPU: say so at the
         # top level so trajectory readers never mistake a CPU number for a
         # device number

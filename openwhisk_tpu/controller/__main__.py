@@ -40,6 +40,21 @@ def main() -> None:
                              "(SURVEY §5.4 checkpoint/resume)")
     parser.add_argument("--balancer-snapshot-interval", type=float,
                         default=10.0)
+    parser.add_argument("--balancer-journal", default=None,
+                        help="directory for the write-ahead placement "
+                             "journal: every committed device-state "
+                             "mutation is logged so restore = snapshot + "
+                             "deterministic tail replay (bounded amnesia; "
+                             "see docs/tpu-balancer.md 'HA, journaling & "
+                             "failover')")
+    parser.add_argument("--ha", action="store_true",
+                        help="epoch-fenced active/standby failover for the "
+                             "stateful balancer: boot as standby, claim "
+                             "placement leadership over the bus when the "
+                             "active dies, restore snapshot+journal and "
+                             "resume placement (point every controller at "
+                             "the same --balancer-snapshot/-journal "
+                             "storage)")
     parser.add_argument("--balancer-rate-limit", type=int, default=None,
                         help="per-namespace activations/minute enforced by "
                              "the DEVICE token bucket fused into the TPU "
@@ -51,7 +66,7 @@ def main() -> None:
         logger = Logging(level="info")
         from ..utils.tracing import maybe_enable_zipkin
         zipkin = maybe_enable_zipkin(f"controller{args.instance}")
-        controller = snapshotter = None
+        controller = snapshotter = journal = None
         try:
             ExecManifest.initialize()
             provider = provider_for_bus(args.bus)
@@ -68,14 +83,56 @@ def main() -> None:
                 lb = ShardingBalancer(provider, instance, logger=logger,
                                       metrics=logger.metrics,
                                       cluster_size=args.cluster_size)
-            if args.balancer_snapshot:
+            if args.balancer_journal and hasattr(lb, "attach_journal"):
+                from .loadbalancer.journal import journal_from_config
+                journal = journal_from_config(args.balancer_journal,
+                                              logger=logger)
+                if journal is not None:
+                    lb.attach_journal(journal)
+            ha_on = False
+            if args.ha:
+                from .loadbalancer.journal import ha_failover_enabled
+                ha_on = ha_failover_enabled()
+                if not ha_on:
+                    logger.warn(None, "--ha requested but "
+                                      "CONFIG_whisk_ha_failover_enabled is "
+                                      "false; running without failover")
+            if args.balancer_snapshot or journal is not None:
                 from .loadbalancer.checkpoint import (BalancerSnapshotter,
                                                       load_snapshot)
-                load_snapshot(lb, args.balancer_snapshot, logger,
-                              cluster_size=args.cluster_size)
-                snapshotter = BalancerSnapshotter(
-                    lb, args.balancer_snapshot,
-                    args.balancer_snapshot_interval, logger).start()
+                if not ha_on:
+                    # non-HA boot: restore right away (HA defers the
+                    # restore to the promotion that claims leadership)
+                    load_snapshot(lb, args.balancer_snapshot or "", logger,
+                                  cluster_size=args.cluster_size,
+                                  journal=journal)
+                if args.balancer_snapshot:
+                    snapshotter = BalancerSnapshotter(
+                        lb, args.balancer_snapshot,
+                        args.balancer_snapshot_interval, logger,
+                        journal=journal).start()
+            if ha_on:
+                from .loadbalancer.checkpoint import load_snapshot
+
+                async def on_leadership(epoch: int, active: bool) -> None:
+                    if active:
+                        # promotion: adopt the dead active's books before
+                        # the first placement of the new epoch. Topology =
+                        # the LIVE membership view (the dead active is
+                        # leaving it), not the deploy-time seed
+                        mem = getattr(controller, "membership", None)
+                        size = (mem.cluster_size if mem is not None
+                                else args.cluster_size)
+                        load_snapshot(lb, args.balancer_snapshot or "",
+                                      logger, cluster_size=size,
+                                      journal=journal)
+                    lb.set_leadership(epoch, active)
+
+                # boot as standby: the membership protocol elects the
+                # active (the lowest live instance claims epoch 1 after a
+                # grace window; a later joiner finds the active already
+                # asserting its epoch and stays standby)
+                lb.set_leadership(0, False)
             # namespace default limits via the CONFIG_whisk_limits_* env
             # channel (ref: LIMITS_ACTIONS_INVOKES_* in
             # ansible/roles/controller/deploy.yml)
@@ -86,6 +143,9 @@ def main() -> None:
                 invocations_per_minute=int(lim.get("invocations_per_minute", 60)),
                 concurrent_invocations=int(lim.get("concurrent_invocations", 30)),
                 fires_per_minute=int(lim.get("fires_per_minute", 60)))
+            if ha_on:
+                controller.ha_failover = True
+                controller.on_leadership = on_leadership
             if args.seed_guest:
                 from ..standalone import guest_identity
                 ident = guest_identity()
@@ -98,9 +158,13 @@ def main() -> None:
             await wait_for_shutdown()
         finally:
             if snapshotter is not None:
-                await snapshotter.stop()
+                # final dump (SIGTERM path): a clean restart then replays
+                # no journal at all instead of up to one interval's worth
+                await snapshotter.stop(final_dump=True)
             if controller is not None:
                 await controller.stop()
+            if journal is not None:
+                await asyncio.to_thread(journal.close)
             if zipkin is not None:
                 await zipkin.close()
 

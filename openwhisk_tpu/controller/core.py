@@ -91,6 +91,12 @@ class Controller:
         # resources an assembler (e.g. standalone) co-locates with this
         # controller; each must expose an async stop()
         self.owned_resources: list = []
+        # HA failover (loadbalancer/membership.py leadership): assemblers
+        # set these BEFORE start() to run the epoch-fenced active/standby
+        # protocol on the membership heartbeats. on_leadership(epoch,
+        # active) may be async (promotion restores snapshot+journal).
+        self.ha_failover = False
+        self.on_leadership = None
 
     # -- rule status handling (status lives on the trigger doc) ------------
     async def rule_status(self, rule) -> str:
@@ -137,7 +143,8 @@ class Controller:
             from .loadbalancer.membership import ControllerMembership
             self.membership = ControllerMembership(
                 self.provider, self.instance, self.load_balancer,
-                logger=self.logger)
+                logger=self.logger, ha=self.ha_failover,
+                on_leadership=self.on_leadership)
             self.membership.start()
         app = self.api.make_app()
         for method, path, handler in self.extra_routes:

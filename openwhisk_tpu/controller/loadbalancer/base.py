@@ -177,6 +177,13 @@ class CommonLoadBalancer(LoadBalancer):
         # restores the raw serial producer bit-exactly.
         from ...messaging.coalesce import maybe_coalesce
         self.producer = maybe_coalesce(messaging_provider.get_producer())
+        # HA failover plane (membership.py leadership): while `ha_standby`
+        # the balancer refuses placement; once active, `fence_epoch` stamps
+        # every produced ActivationMessage so invokers can discard a dead
+        # epoch's late (zombie) batches. Both default to the non-HA
+        # behavior: no stamp, always active.
+        self.fence_epoch: Optional[int] = None
+        self.ha_standby = False
         self.activation_slots: Dict[str, ActivationEntry] = {}
         self.activations_per_namespace: Dict[str, int] = {}
         self._total = 0
@@ -339,10 +346,35 @@ class CommonLoadBalancer(LoadBalancer):
         self.process_completion(entry.id, forced=True, is_system_error=False,
                                 invoker=entry.invoker)
 
+    # -- HA leadership (membership.py fires this on claim/demote) ----------
+    def set_leadership(self, epoch: int, active: bool) -> None:
+        """Adopt a leadership transition: the fencing epoch stamps every
+        later dispatch; a standby refuses placement until promoted."""
+        if epoch:
+            self.fence_epoch = int(epoch)
+        if not active:
+            # demotion: drop the journal's buffered tail NOW — a
+            # superseded active must not flush stale frames into the log
+            # the new epoch's active owns (journal.abandon docstring)
+            journal = getattr(self, "journal", None)
+            if journal is not None and hasattr(journal, "abandon"):
+                journal.abandon()
+        self.ha_standby = not active
+        self.metrics.gauge("controller_leadership_epoch", int(epoch))
+        if self.logger:
+            self.logger.info(
+                TransactionId.LOADBALANCER,
+                f"leadership epoch {epoch}: this controller is now "
+                f"{'ACTIVE' if active else 'standby'}", "LoadBalancer")
+
     # -- dispatch (ref :175-198) -------------------------------------------
     async def send_activation_to_invoker(self, msg: ActivationMessage,
                                          invoker: InvokerInstanceId) -> None:
         topic = invoker.as_string  # "invoker<N>"
+        if self.fence_epoch is not None:
+            # epoch fencing: invokers discard messages from a superseded
+            # epoch, so a zombie active's late batches never double-run
+            msg.fence_epoch = self.fence_epoch
         self.metrics.counter("loadbalancer_activations_published")
         await self.producer.send(topic, msg)
 

@@ -13,15 +13,34 @@ immediately instead of waiting out the timeout.
 
 The deploy-time `--cluster-size` remains the initial value (the
 reference's seed-node list); membership converges from there.
+
+HA leadership (`ha=True`): the same heartbeat stream carries an
+epoch-fenced active/standby claim for the STATEFUL balancer's placement
+role. The active's heartbeats assert (epoch, instance); when a standby
+sees the active silent for `member_timeout_s` — and it is the
+lowest-numbered live controller — it claims epoch+1, restores
+snapshot+journal (the `on_leadership` callback) and resumes placement.
+Epoch precedence (higher epoch wins; ties break to the LOWER instance)
+demotes any stale active the moment it hears a superseding claim, and the
+epoch itself is stamped into every dispatched ActivationMessage so
+invokers discard a zombie's late batches (invoker/reactive.py) — the
+no-double-placement half of the failover contract. Two standbys with
+split membership views can claim the same epoch for up to one heartbeat;
+the tie-break demotes the higher instance within the next heartbeat, and
+fencing makes the overlap harmless for double-execution (equal-epoch
+messages both pass, but each activation id is placed by exactly one
+controller).
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import Dict, Optional
 
 from ...messaging.connector import MessageFeed
 from ...utils.scheduler import Scheduler
+from ...utils.tasks import spawn
 from ...utils.transaction import TransactionId
 
 CONTROLLERS_TOPIC = "controllers"
@@ -36,7 +55,8 @@ MEMBER_TIMEOUT_S = 5.0
 class ControllerMembership:
     def __init__(self, messaging_provider, instance, balancer, logger=None,
                  heartbeat_s: float = HEARTBEAT_S,
-                 member_timeout_s: float = MEMBER_TIMEOUT_S):
+                 member_timeout_s: float = MEMBER_TIMEOUT_S,
+                 ha: bool = False, on_leadership=None):
         self.provider = messaging_provider
         self.instance = instance
         self.balancer = balancer
@@ -52,6 +72,13 @@ class ControllerMembership:
         self._seed_size = 1
         self._started = 0.0
         self._last_tick = 0.0
+        #: HA leadership: epoch-fenced active/standby claim (module doc)
+        self.ha = ha
+        self.on_leadership = on_leadership
+        self._lead_epoch = 0
+        self._lead_instance: Optional[int] = None
+        self._lead_seen = 0.0
+        self._is_active = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -107,16 +134,29 @@ class ControllerMembership:
             return
         if kind == "leave":
             self._last_seen.pop(inst, None)
+            if self.ha and inst == self._lead_instance:
+                # a graceful active departure frees the claim immediately:
+                # age its lease out so the next tick elects without the
+                # full silence timeout
+                self._lead_seen = 0.0
             self._refold()
         else:
             joined = inst not in self._last_seen
             self._last_seen[inst] = time.monotonic()
+            if self.ha and msg.get("active"):
+                self._observe_claim(int(msg.get("epoch", 0)), inst)
             if joined:
                 self._refold()
 
+    def _heartbeat_msg(self) -> bytes:
+        hb = {"kind": "heartbeat", "instance": self.instance.instance}
+        if self.ha:
+            hb["epoch"] = self._lead_epoch
+            hb["active"] = self._is_active
+        return json.dumps(hb).encode()
+
     async def _tick(self) -> None:
-        await self._producer.send(CONTROLLERS_TOPIC, json.dumps(
-            {"kind": "heartbeat", "instance": self.instance.instance}).encode())
+        await self._producer.send(CONTROLLERS_TOPIC, self._heartbeat_msg())
         now = time.monotonic()
         # Stall guard: if OUR OWN ticks gapped (event loop blocked — e.g. a
         # long jit compile — or host pause), peer silence is our fault, not
@@ -138,6 +178,88 @@ class ControllerMembership:
         # converges the case where a seeded peer never appeared at all once
         # the boot grace window lapses
         self._refold()
+        if self.ha:
+            await self._leadership_tick(now)
+
+    # -- HA leadership (module doc) ----------------------------------------
+    async def _leadership_tick(self, now: float) -> None:
+        if self._is_active:
+            self._lead_seen = now  # our own heartbeat is the lease
+            return
+        leader_alive = (self._lead_instance is not None
+                        and now - self._lead_seen <= self.member_timeout_s)
+        if leader_alive:
+            return
+        # boot grace: give an already-running active one full timeout to be
+        # heard before a fresh standby steals the epoch from it
+        if now - self._started < self.member_timeout_s:
+            return
+        if self._last_seen and self.instance.instance > min(self._last_seen):
+            return  # a lower-numbered live controller claims first
+        await self._claim(now)
+
+    async def _claim(self, now: float) -> None:
+        self._lead_epoch += 1
+        self._lead_instance = self.instance.instance
+        self._lead_seen = now
+        self._is_active = True
+        if self.logger:
+            self.logger.info(
+                TransactionId.LOADBALANCER,
+                f"claiming placement leadership: epoch {self._lead_epoch} "
+                f"(instance {self.instance.instance})", "Membership")
+        self._export_epoch()
+        # announce immediately — peers demote/stand down without waiting
+        # out a heartbeat interval
+        try:
+            await self._producer.send(CONTROLLERS_TOPIC,
+                                      self._heartbeat_msg())
+        except Exception:  # noqa: BLE001 — next tick re-announces
+            pass
+        self._fire_leadership(True)
+
+    def _observe_claim(self, epoch: int, inst: int) -> None:
+        """Fold a peer's active assertion. Precedence: higher epoch wins;
+        equal epochs break to the lower instance (split-claim tie)."""
+        better = (epoch > self._lead_epoch
+                  or (epoch == self._lead_epoch
+                      and (self._lead_instance is None
+                           or inst <= self._lead_instance)))
+        if not better:
+            return
+        now = time.monotonic()
+        if inst == self._lead_instance and epoch == self._lead_epoch:
+            self._lead_seen = now  # lease renewal
+            return
+        was_active = self._is_active
+        self._lead_epoch = epoch
+        self._lead_instance = inst
+        self._lead_seen = now
+        if was_active:
+            # superseded: a peer holds a higher (or tie-winning) claim —
+            # stop placing NOW; our fencing epoch is already dead at the
+            # invokers for epoch > ours
+            self._is_active = False
+            if self.logger:
+                self.logger.warn(
+                    TransactionId.LOADBALANCER,
+                    f"leadership superseded by instance {inst} epoch "
+                    f"{epoch}; demoting to standby", "Membership")
+            self._fire_leadership(False)
+        self._export_epoch()
+
+    def _fire_leadership(self, active: bool) -> None:
+        cb = self.on_leadership
+        if cb is None:
+            return
+        res = cb(self._lead_epoch, active)
+        if asyncio.iscoroutine(res):
+            spawn(res, logger=self.logger, name="leadership-transition")
+
+    def _export_epoch(self) -> None:
+        metrics = getattr(self.balancer, "metrics", None)
+        if metrics is not None:
+            metrics.gauge("controller_leadership_epoch", self._lead_epoch)
 
     def _refold(self) -> None:
         n = 1 + len(self._last_seen)  # self + live peers
@@ -160,3 +282,14 @@ class ControllerMembership:
     @property
     def cluster_size(self) -> int:
         return self._current_size or 1
+
+    @property
+    def is_active(self) -> bool:
+        """HA mode: does this controller currently hold the placement
+        leadership? (Always False when ha is off — callers should then
+        treat every controller as active.)"""
+        return self._is_active
+
+    @property
+    def leadership_epoch(self) -> int:
+        return self._lead_epoch

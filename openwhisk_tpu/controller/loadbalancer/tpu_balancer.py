@@ -52,6 +52,7 @@ from ...ops.placement import (PlacementState, RequestBatch, init_state,
                               release_batch, release_batch_vector,
                               schedule_batch, schedule_batch_repair,
                               set_health, unpack_chosen, unpack_step_output)
+from .journal import decode_array, encode_array
 from ...ops.throttle import init_buckets
 from ...utils.config import load_config
 from ...utils.ring_buffer import ColumnRing
@@ -294,6 +295,15 @@ class TpuBalancer(CommonLoadBalancer):
         self.state: Optional[PlacementState] = None
         self._sched_fn = None
         self._release_fn = None
+        #: write-ahead placement journal (loadbalancer/journal.py): None =
+        #: journaling off, the bit-exact legacy path. Every committed
+        #: device-state mutation appends one record; a restored controller
+        #: replays the tail on top of the snapshot (replay_journal).
+        self.journal = None
+        self._journal_seq = 0
+        #: True while replay_journal re-applies records, so the re-applied
+        #: mutations don't journal themselves again
+        self._journal_mute = False
         #: host numpy copy of free_mb from the last readback/state install —
         #: occupancy() serves from this, never the live device buffer.
         #: Installs are sequence-guarded: readback worker threads finish
@@ -366,6 +376,10 @@ class TpuBalancer(CommonLoadBalancer):
         # and consumer reconnects (messaging/{coalesce,tcp}.py)
         export_coalesce_gauges(self.metrics)
         export_bus_gauges(self.metrics)
+        # journal durability lag / size / fsync tail (HA plane) ride the
+        # same 1 Hz cadence
+        if self.journal is not None:
+            self.journal.export_gauges(self.metrics)
 
     # -- device state ------------------------------------------------------
     def _resolve_kernel(self) -> str:
@@ -684,6 +698,11 @@ class TpuBalancer(CommonLoadBalancer):
             # occupancy's cached books must learn the fresh rows' capacity
             # (registration is rare; the sync transfer is n_pad int32s)
             self._set_books_now(np.asarray(self.state.free_mb))
+            if self._journal_live():
+                self._journal_append({
+                    "t": "reg",
+                    "reg": [self._registry[i].to_json() for i in new_rows],
+                    "healthy": [bool(self._healthy[i]) for i in new_rows]})
         self._health_updates[idx] = self._healthy[idx]
         self._recompute_partitions()
 
@@ -737,6 +756,9 @@ class TpuBalancer(CommonLoadBalancer):
         if bucket_gone:
             self._bucket_state = None
         self._init_device_state()
+        if self._journal_live():
+            # books were rebuilt at full capacity: replay must do the same
+            self._journal_append({"t": "reinit"})
         return True
 
     def _books_ref(self):
@@ -790,6 +812,8 @@ class TpuBalancer(CommonLoadBalancer):
         self._install_state(PlacementState(jnp.asarray(free),
                                            jnp.asarray(conc),
                                            jnp.asarray(health)))
+        if self._journal_live():
+            self._journal_append({"t": "grow", "n_pad": new_pad})
 
     def _ensure_slot_capacity(self, slot_key: str) -> None:
         """Grow the concurrency-slot axis before the allocator runs dry, the
@@ -835,6 +859,8 @@ class TpuBalancer(CommonLoadBalancer):
                                            jnp.asarray(conc),
                                            st.health))
         self.metrics.counter("loadbalancer_action_slot_growth")
+        if self._journal_live():
+            self._journal_append({"t": "slots", "action_slots": new_slots})
         if self.logger:
             self.logger.info(
                 None, f"grew action concurrency slots to {new_slots}")
@@ -860,6 +886,8 @@ class TpuBalancer(CommonLoadBalancer):
             self.profiler.expect("cluster_resize")
             self._init_device_state()
             self._recompute_partitions()  # capacity shares changed
+            if self._journal_live():
+                self._journal_append({"t": "cluster", "size": cluster_size})
 
     @property
     def cluster_size(self) -> int:
@@ -903,6 +931,13 @@ class TpuBalancer(CommonLoadBalancer):
     # -- publish -----------------------------------------------------------
     async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
                       ) -> asyncio.Future:
+        if self.ha_standby:
+            # HA failover mode: placement is fenced to the active leader —
+            # refusing BEFORE any state change makes the 503 safe for the
+            # edge to retry on the active upstream
+            raise LoadBalancerException(
+                "standby controller: placement is fenced to the active "
+                "leader")
         n = len(self._registry)
         if n == 0 or not any(self._healthy):
             raise LoadBalancerException(
@@ -1083,6 +1118,195 @@ class TpuBalancer(CommonLoadBalancer):
         sync (memory_stats is a runtime counter read, not an array pull)."""
         return self.profiler.profile_json(kernel=self.kernel_resolved)
 
+    # -- placement journal (HA plane; loadbalancer/journal.py) -------------
+    def attach_journal(self, journal) -> None:
+        """Adopt a PlacementJournal. Appends start from the max of the
+        balancer's own seq and what the log already holds, so a restarted
+        active never reuses a sequence number."""
+        self.journal = journal
+        if journal is not None:
+            self._journal_seq = max(self._journal_seq, journal.last_seq())
+
+    def _journal_live(self) -> bool:
+        return (self.journal is not None and not self._journal_mute
+                and not self.ha_standby)
+
+    def _journal_append(self, rec: dict) -> int:
+        """Stamp the next seq (and fencing epoch) onto `rec` and append.
+        Returns the seq (0 when journaling is off). Called on the event
+        loop in the SAME synchronous block as the state mutation it
+        records, so journal order == device-state mutation order and a
+        snapshot's `journal_seq` is exactly consistent with its books."""
+        if not self._journal_live():
+            return 0
+        self._journal_seq += 1
+        rec["seq"] = self._journal_seq
+        if self.fence_epoch is not None:
+            rec["epoch"] = self.fence_epoch
+        try:
+            self.journal.append(rec)
+        except Exception as e:  # noqa: BLE001 — journaling degrades, the
+            # placement path never dies for the flight data recorder
+            if self.logger:
+                self.logger.warn(None, f"journal append failed: {e!r}; "
+                                       "detaching journal", "TpuBalancer")
+            self.journal = None
+        return rec.get("seq", 0)
+
+    def replay_journal(self, records, logger=None,
+                       from_seq: Optional[int] = None) -> dict:
+        """Deterministically re-execute a journal tail on top of the
+        current (snapshot-restored) state. Batch records re-run the SAME
+        schedule/release kernels the active used (non-donated replay
+        programs) over the recorded packed input buffers — placement is
+        bit-deterministic (ops/placement parity suite), so the re-derived
+        books equal the dead active's and the re-derived decisions equal
+        the journaled readback (`parity_mismatches` counts divergence,
+        e.g. a kernel-knob change across the restart). Structural records
+        (registration/growth/cluster) re-apply their host-side mutation.
+
+        Batches journaled at dispatch but crashed before readback replay
+        with their full request set (conservative over-hold: those
+        placements were computed on the dead device; self-heal via forced
+        timeouts reclaims them, exactly the checkpoint posture)."""
+        log = logger or self.logger
+        if from_seq is not None:
+            self._journal_seq = int(from_seq)
+        stats = {"replayed": 0, "batches": 0, "parity_mismatches": 0,
+                 "from_seq": self._journal_seq}
+        if self.mesh is not None:
+            if log:
+                log.warn(None, "journal replay is not supported on a "
+                               "sharded mesh balancer; skipping", "TpuBalancer")
+            stats["skipped"] = "mesh"
+            return stats
+        self.profiler.expect("snapshot_restore")
+        recs = [r for r in records]
+        # stale-epoch filter: a demoted active's already-popped write batch
+        # can still land in its own old segment AFTER the new epoch began —
+        # any record whose epoch is superseded at-or-before its seq was
+        # never part of the promoted active's state and must not replay
+        first_seq: Dict[int, int] = {}
+        for r in recs:
+            e, s = int(r.get("epoch", 0)), int(r.get("seq", 0))
+            first_seq[e] = min(first_seq.get(e, s), s)
+        bounds = sorted(first_seq.items())
+
+        def _fresh(r: dict) -> bool:
+            e, s = int(r.get("epoch", 0)), int(r.get("seq", 0))
+            return not any(e2 > e and s2 <= s for e2, s2 in bounds)
+
+        n_all = len(recs)
+        recs = [r for r in recs if _fresh(r)]
+        stats["stale_epoch_dropped"] = n_all - len(recs)
+        # acks key their dispatch-time batch record by `for` (the ack's own
+        # seq only orders it in the log)
+        acks = {int(r["for"]): r for r in recs
+                if r.get("t") == "ack" and "for" in r}
+        replay_step = make_fused_step_packed(self._release_fn, self._sched_fn)
+        replay_release = make_release_packed(self._release_fn)
+        self._journal_mute = True
+        try:
+            for rec in recs:
+                t = rec.get("t")
+                seq = int(rec.get("seq", 0))
+                if t == "ack":
+                    # already applied through its batch record; still claim
+                    # the seq so the promoted active never reuses it
+                    self._journal_seq = max(self._journal_seq, seq)
+                    continue
+                if seq <= self._journal_seq:
+                    continue
+                if t == "batch":
+                    self._replay_batch(rec, acks.get(seq), replay_step,
+                                       stats)
+                elif t == "fold":
+                    self._replay_fold(rec, replay_release)
+                elif t == "reg":
+                    self._replay_reg(rec)
+                elif t == "grow":
+                    if int(rec["n_pad"]) > self._n_pad:
+                        self._grow_padding(int(rec["n_pad"]))
+                elif t == "slots":
+                    if int(rec["action_slots"]) > self.action_slots:
+                        self._grow_slots(int(rec["action_slots"]))
+                elif t == "cluster":
+                    self.update_cluster(int(rec["size"]))
+                elif t == "reinit":
+                    self._init_device_state()
+                elif log:
+                    log.warn(None, f"journal record type {t!r} unknown "
+                                   "(newer writer?); skipped", "TpuBalancer")
+                stats["replayed"] += 1
+                self._journal_seq = max(self._journal_seq, seq)
+        finally:
+            self._journal_mute = False
+        self._set_books_now(np.asarray(self.state.free_mb))
+        stats["last_seq"] = self._journal_seq
+        if stats["parity_mismatches"] and log:
+            log.warn(None, f"journal replay re-derived "
+                           f"{stats['parity_mismatches']} decisions "
+                           "differently than the recorded readback (kernel "
+                           "knobs changed across the restart?)", "TpuBalancer")
+        return stats
+
+    def _replay_batch(self, rec: dict, ack: Optional[dict], replay_step,
+                      stats: dict) -> None:
+        R, H, B = int(rec["R"]), int(rec["H"]), int(rec["B"])
+        rows, b = int(rec["rows"]), int(rec["b"])
+        buf = decode_array(rec["buf"])
+        rel = buf[:5 * R]
+        health = buf[5 * R:5 * R + 3 * H]
+        req = buf[5 * R + 3 * H:].reshape(rows, B)[:9].copy()
+        if ack is not None:
+            out_rec = np.asarray(ack["out"], np.int64)
+            throttled = ((out_rec >> 1) & 1).astype(bool)
+            # device rate admission already rejected these at commit time:
+            # replay with their valid bit cleared so the re-derived books
+            # hold exactly what the committed step held
+            req[8, :len(throttled)] &= ~throttled
+        buf9 = np.concatenate([rel, health, req.ravel()]).astype(np.int32)
+        self.state, out = replay_step(self.state, buf9, R, H, B)
+        stats["batches"] += 1
+        if ack is not None:
+            derived = np.asarray(out)[:b].astype(np.int64)
+            recorded = np.asarray(ack["out"], np.int64)[:b]
+            thr = ((recorded >> 1) & 1).astype(bool)
+            stats["parity_mismatches"] += int(
+                np.count_nonzero(derived[~thr] != recorded[~thr]))
+
+    def _replay_fold(self, rec: dict, replay_release) -> None:
+        if "rel" in rec:
+            rel = decode_array(rec["rel"]).reshape(5, -1)
+            self.state = replay_release(self.state, rel)
+        health = rec.get("health")
+        if health:
+            self.state = set_health(self.state,
+                                    [int(i) for i, _ in health],
+                                    [bool(v) for _, v in health])
+
+    def _replay_reg(self, rec: dict) -> None:
+        new_rows = []
+        for j, healthy in zip(rec["reg"], rec["healthy"]):
+            inv = InvokerInstanceId.from_json(j)
+            idx = inv.instance
+            while idx >= len(self._registry):
+                new_rows.append(len(self._registry))
+                self._registry.append(inv)
+                self._healthy.append(False)
+            self._registry[idx] = inv
+            self._healthy[idx] = bool(healthy)
+        if new_rows:
+            if len(self._registry) > self._n_pad:
+                self._grow_padding(_next_pow2(len(self._registry)))
+            slot_vals = jnp.asarray(
+                [self._slot_mb(self._registry[i].user_memory.to_mb)
+                 for i in new_rows], jnp.int32)
+            self.state = self.state._replace(
+                free_mb=self.state.free_mb.at[jnp.asarray(new_rows)].set(
+                    slot_vals))
+        self._recompute_partitions()
+
     # -- checkpoint / resume (SURVEY §5.4) ---------------------------------
     def snapshot_parts(self) -> dict:
         """Event-loop-side capture for a snapshot: ONE consistent reference
@@ -1095,6 +1319,7 @@ class TpuBalancer(CommonLoadBalancer):
         dispatch before the worker thread gets to read it."""
         return {
             "state": self._materialize_state(),
+            "journal_seq": self._journal_seq,
             "n_pad": self._n_pad,
             "cluster_size": self._cluster_size,
             "action_slots": self.action_slots,
@@ -1123,6 +1348,10 @@ class TpuBalancer(CommonLoadBalancer):
 
     def restore(self, snap: dict) -> None:
         self.profiler.expect("snapshot_restore")
+        # the snapshot's books already hold every journaled mutation up to
+        # this seq: replay_journal resumes from here (older snapshots carry
+        # no seq — a full-history journal replays from 0)
+        self._journal_seq = int(snap.get("journal_seq", 0))
         self._n_pad = int(snap["n_pad"])
         self._cluster_size = int(snap["cluster_size"])
         # older snapshots predate the growable slot axis
@@ -1303,13 +1532,22 @@ class TpuBalancer(CommonLoadBalancer):
             # fused path) and health (exact-size; dict keys are unique)
             folded = bool(self._releases)
             try:
+                rel_np = ups = None
                 if self._releases:
-                    self.state = self._release_packed_fn(
-                        self.state, self._release_packed())
+                    rel_np = self._release_packed()
+                    self.state = self._release_packed_fn(self.state, rel_np)
                 if self._health_updates:
                     ups, self._health_updates = self._health_updates, {}
                     self.state = set_health(self.state, list(ups.keys()),
                                             list(ups.values()))
+                if (rel_np is not None or ups) and self._journal_live():
+                    fold = {"t": "fold"}
+                    if rel_np is not None:
+                        fold["rel"] = encode_array(rel_np)
+                    if ups:
+                        fold["health"] = [[int(k), bool(v)]
+                                          for k, v in ups.items()]
+                    self._journal_append(fold)
             except Exception as e:  # noqa: BLE001 — a failed donated fold
                 # may have CONSUMED self.state: without a rebuild every
                 # later idle fold dies on the deleted buffer and a
@@ -1426,6 +1664,16 @@ class TpuBalancer(CommonLoadBalancer):
                                   "TpuBalancer")
             return
 
+        # write-ahead journal: the state mutation above is committed on
+        # the loop, so the record lands at exactly this point in mutation
+        # order (readback appends a matching `ack` with the decisions)
+        jseq = 0
+        if self._journal_live():
+            jseq = self._journal_append({
+                "t": "batch", "R": int(rel_np.shape[1]),
+                "H": int(health_np.shape[1]), "B": bp,
+                "rows": rows, "b": b, "buf": encode_array(buf),
+                "aids": [e[4] for e in batch]})
         # compile-ahead: warm the successor bucket shapes off-loop before
         # queue growth needs them in a live dispatch
         self._prewarm_buckets(rel_np.shape[1], health_np.shape[1], bp)
@@ -1475,7 +1723,7 @@ class TpuBalancer(CommonLoadBalancer):
         books = self._books_ref()
         task = asyncio.get_event_loop().create_task(
             self._readback_step(batch, b, out, t0, req_np, rec, books,
-                                self._next_books_seq()))
+                                self._next_books_seq(), jseq))
         self._readbacks.add(task)
         task.add_done_callback(self._readbacks.discard)
 
@@ -1503,7 +1751,8 @@ class TpuBalancer(CommonLoadBalancer):
         return unpack_step_output(np.asarray(out))
 
     async def _readback_step(self, batch, b, out, t0, req_np, rec=None,
-                             books_free=None, books_seq=0) -> None:
+                             books_free=None, books_seq=0,
+                             journal_seq=0) -> None:
         # the step-duration stamp is taken ON the worker thread so the
         # metric measures device step + readback, not loop re-scheduling
         def _read():
@@ -1543,6 +1792,16 @@ class TpuBalancer(CommonLoadBalancer):
             (chosen_np, forced_np, throttled_np, rounds), t_done, books_np = \
                 await asyncio.to_thread(_read)
             self._install_books(books_np, books_seq)
+            if journal_seq and self._journal_live():
+                # the committed decision vector, keyed to the dispatch-time
+                # batch record: replay asserts parity against it, and the
+                # throttled bits tell replay which requests the device rate
+                # admission rejected (they consumed no capacity)
+                enc = (((chosen_np[:b].astype(np.int64) + 1) << 2)
+                       | (throttled_np[:b].astype(np.int64) << 1)
+                       | forced_np[:b].astype(np.int64))
+                self._journal_append({"t": "ack", "for": journal_seq,
+                                      "out": [int(v) for v in enc]})
         except Exception as e:  # noqa: BLE001 — publishers must not hang,
             # and their host-side conc slots must not leak. The DISPATCH
             # succeeded (only the host conversion failed), so the device
@@ -1559,6 +1818,13 @@ class TpuBalancer(CommonLoadBalancer):
                     jnp.asarray(req_np[6]),
                     jnp.asarray(req_np[8]) * (chosen >= 0).astype(jnp.int32)])
                 self.state = self._release_packed_fn(self.state, rel)
+                if journal_seq and self._journal_live():
+                    # the dispatch-time batch record stands; journal its
+                    # on-device reversal so replay undoes it identically
+                    # (np.asarray syncs, but this is already an error path)
+                    self._journal_append({"t": "fold",
+                                          "rel": encode_array(
+                                              np.asarray(rel))})
             except Exception:  # noqa: BLE001 — device genuinely dead: keep
                 # the host refcounts PINNED so the slot indices cannot be
                 # reassigned to a different action and inherit the phantom

@@ -128,6 +128,7 @@ class EdgeProxy:
         qs = request.query_string
         suffix = target + (("?" + qs) if qs else "")
         last_error: Optional[Exception] = None
+        last_503: Optional[web.Response] = None
         for upstream in self._pick_order():
             try:
                 async with self._session.request(
@@ -140,6 +141,16 @@ class EdgeProxy:
                                    if k.lower() not in HOP_HEADERS
                                    and k.lower() != "content-encoding"}
                     out_headers[TRANSACTION_HEADER] = transid
+                    if resp.status == 503:
+                        # a 503 is emitted BEFORE any state change (an HA
+                        # standby refusing placement, or no usable fleet):
+                        # trying the next upstream is safe for any method
+                        # (nginx `proxy_next_upstream http_503`). No
+                        # blacklist — a standby answers everything else
+                        # fine and becomes active without re-resolving.
+                        last_503 = web.Response(status=503, body=payload,
+                                                headers=out_headers)
+                        continue
                     return web.Response(status=resp.status, body=payload,
                                         headers=out_headers)
             except aiohttp.ClientConnectorError as e:
@@ -158,6 +169,10 @@ class EdgeProxy:
                     last_error = RuntimeError("upstream read failed")
                     continue
                 return web.Response(status=504, text="upstream timeout")
+        if last_503 is not None:
+            # every upstream said 503: surface the real refusal (body and
+            # all) instead of a generic 502
+            return last_503
         return web.Response(status=502, text=f"no upstream available: {last_error}")
 
     def _pick_order(self) -> List[Upstream]:

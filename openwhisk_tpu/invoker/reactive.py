@@ -69,6 +69,14 @@ class InvokerReactive:
         from .blacklist import NamespaceBlacklist
         self.blacklist = NamespaceBlacklist(AuthStore(entity_store.store))
         self._blacklist_poller: Optional[Scheduler] = None
+        #: HA epoch fencing: the highest placement-leadership epoch seen on
+        #: this invoker's topic. A message stamped with a LOWER epoch is a
+        #: zombie active's late batch — the standby that superseded it owns
+        #: placement now — and is discarded instead of run (the
+        #: no-double-execution half of the failover contract). -1 until the
+        #: first fenced message; unfenced messages never participate.
+        self._max_fence_epoch = -1
+        self.fenced_discards = 0
 
     # -- capacity: maxPeek mirrors ref :172-173 -----------------------------
     def max_peek(self) -> int:
@@ -147,6 +155,23 @@ class InvokerReactive:
                                   f"corrupt activation message: {e!r}", "InvokerReactive")
             release()
             return
+        if msg.fence_epoch is not None:
+            if msg.fence_epoch < self._max_fence_epoch:
+                # a superseded epoch's late batch: the current active (or
+                # its own retry path) owns this work now — running it here
+                # would double-place
+                self.fenced_discards += 1
+                if self.metrics is not None:
+                    self.metrics.counter("invoker_fenced_discards")
+                if self.logger:
+                    self.logger.warn(
+                        msg.transid,
+                        f"discarding activation {msg.activation_id} from "
+                        f"fenced epoch {msg.fence_epoch} (current "
+                        f"{self._max_fence_epoch})", "InvokerReactive")
+                release()
+                return
+            self._max_fence_epoch = msg.fence_epoch
         from ..utils.tracing import GLOBAL_TRACER
         # waterfall: the activation is off the bus and in the invoker's
         # hands (single-process deployments share the controller's stage

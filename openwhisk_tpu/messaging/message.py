@@ -39,7 +39,8 @@ class ActivationMessage(Message):
                  blocking: bool, content: Optional[Dict[str, Any]] = None,
                  init_args: Optional[Dict[str, Any]] = None,
                  cause: Optional[ActivationId] = None,
-                 trace_context: Optional[Dict[str, str]] = None):
+                 trace_context: Optional[Dict[str, str]] = None,
+                 fence_epoch: Optional[int] = None):
         self.transid = transid
         self.action = action
         self.revision = revision
@@ -51,9 +52,15 @@ class ActivationMessage(Message):
         self.init_args = init_args or {}
         self.cause = cause
         self.trace_context = trace_context
+        #: HA fencing (loadbalancer/membership.py): the placement
+        #: leadership epoch of the controller that dispatched this.
+        #: Invokers discard messages from a superseded epoch so a zombie
+        #: active's late batches never double-run. None (the default, and
+        #: the whole non-HA path) means unfenced.
+        self.fence_epoch = fence_epoch
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "transid": self.transid.to_json(),
             "action": str(self.action),
             "revision": self.revision,
@@ -66,6 +73,11 @@ class ActivationMessage(Message):
             "cause": self.cause.to_json() if self.cause else None,
             "traceContext": self.trace_context,
         }
+        if self.fence_epoch is not None:
+            # only on the wire when fencing is live: the non-HA message
+            # stays byte-identical to the pre-HA format
+            out["fenceEpoch"] = self.fence_epoch
+        return out
 
     @classmethod
     def from_json(cls, j: dict) -> "ActivationMessage":
@@ -81,6 +93,7 @@ class ActivationMessage(Message):
             j.get("initArgs") or {},
             ActivationId(j["cause"]) if j.get("cause") else None,
             j.get("traceContext"),
+            j.get("fenceEpoch"),
         )
 
     @classmethod

@@ -39,6 +39,9 @@ async def make_standalone(port: int = 3233, artifact_store=None,
                           user_memory_mb: int = 2048, logger=None,
                           prewarm: bool = False, manifest: Optional[dict] = None,
                           balancer: str = "lean", ui: bool = True,
+                          snapshot_path: Optional[str] = None,
+                          snapshot_interval: float = 10.0,
+                          journal_dir: Optional[str] = None,
                           **controller_kw) -> Controller:
     """Assemble and start a standalone server; returns the running Controller.
 
@@ -46,7 +49,13 @@ async def make_standalone(port: int = 3233, artifact_store=None,
     LeanBalancer mode) or "tpu" (the device placement kernel fed by the
     in-process invoker's real health pings). Extra keyword arguments pass
     through to Controller (e.g. invocations_per_minute for perf runs that
-    must not trip the default throttles)."""
+    must not trip the default throttles).
+
+    snapshot_path/journal_dir (tpu balancer only): checkpoint/journal the
+    balancer's books — restored at boot (snapshot + deterministic journal
+    tail replay) and dumped one final time on a clean shutdown, wired
+    through Controller.owned_resources so SIGTERM cannot skip the final
+    dump."""
     logger = logger or Logging(level="warn")
     ExecManifest.initialize(manifest)
     provider = MemoryMessagingProvider()
@@ -65,11 +74,27 @@ async def make_standalone(port: int = 3233, artifact_store=None,
         await invoker.start(start_prewarm=prewarm)
         return invoker
 
+    journal = None
+    snapshotter = None
     if balancer == "tpu":
         from ..controller.loadbalancer.tpu_balancer import TpuBalancer
         lb = TpuBalancer(provider, instance, logger=logger,
                          metrics=logger.metrics,
                          managed_fraction=1.0, blackbox_fraction=0.0)
+        if snapshot_path or journal_dir:
+            from ..controller.loadbalancer.checkpoint import (
+                BalancerSnapshotter, load_snapshot)
+            if journal_dir:
+                from ..controller.loadbalancer.journal import \
+                    journal_from_config
+                journal = journal_from_config(journal_dir, logger=logger)
+                if journal is not None:
+                    lb.attach_journal(journal)
+            load_snapshot(lb, snapshot_path or "", logger, journal=journal)
+            if snapshot_path:
+                snapshotter = BalancerSnapshotter(
+                    lb, snapshot_path, snapshot_interval, logger,
+                    journal=journal).start()
     else:
         # metrics=logger.metrics: the controller serves this emitter at
         # /metrics — sharing it puts the lean balancer's counters AND its
@@ -83,6 +108,18 @@ async def make_standalone(port: int = 3233, artifact_store=None,
         controller_kw["extra_routes"] = playground_routes(GUEST_UUID, GUEST_KEY)
     controller = Controller(instance, provider, artifact_store=artifact_store,
                             logger=logger, load_balancer=lb, **controller_kw)
+    if snapshotter is not None:
+        # Controller.stop() drains owned_resources BEFORE closing the
+        # balancer: the final dump always sees live books, and the SIGTERM
+        # path (utils.tasks.wait_for_shutdown -> controller.stop) can no
+        # longer skip it
+        controller.owned_resources.append(snapshotter)
+    if journal is not None:
+        class _JournalCloser:
+            async def stop(self_inner) -> None:
+                await asyncio.to_thread(journal.close)
+
+        controller.owned_resources.append(_JournalCloser())
     # seed the guest identity
     ident = guest_identity()
     await controller.auth_store.put(

@@ -80,6 +80,15 @@ def main() -> None:
     parser.add_argument("--manifest", default=None,
                         help="runtimes manifest JSON file (default: built-in "
                              "python:3 + nodejs:14)")
+    parser.add_argument("--balancer-snapshot", default=None,
+                        help="(tpu balancer) path for periodic balancer "
+                             "snapshots, restored at boot; the final dump "
+                             "rides the SIGTERM shutdown path")
+    parser.add_argument("--balancer-snapshot-interval", type=float,
+                        default=10.0)
+    parser.add_argument("--balancer-journal", default=None,
+                        help="(tpu balancer) write-ahead placement journal "
+                             "directory (snapshot + tail replay at boot)")
     args = parser.parse_args()
 
     # parse the manifest file exactly once; preflight and the server get
@@ -108,13 +117,14 @@ def main() -> None:
             if args.db:
                 from ..database import open_store
                 store = open_store(args.db)
-            controller = await make_standalone(port=args.port,
-                                               artifact_store=store,
-                                               user_memory_mb=args.memory,
-                                               prewarm=args.prewarm,
-                                               balancer=args.balancer,
-                                               ui=not args.no_ui,
-                                               manifest=manifest)
+            controller = await make_standalone(
+                port=args.port, artifact_store=store,
+                user_memory_mb=args.memory, prewarm=args.prewarm,
+                balancer=args.balancer, ui=not args.no_ui,
+                manifest=manifest,
+                snapshot_path=args.balancer_snapshot,
+                snapshot_interval=args.balancer_snapshot_interval,
+                journal_dir=args.balancer_journal)
             print(f"OpenWhisk-TPU standalone listening on :{args.port} "
                   f"(balancer={args.balancer})")
             print(f"  AUTH     {GUEST_UUID}:{GUEST_KEY}")

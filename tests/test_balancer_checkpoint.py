@@ -71,6 +71,41 @@ class TestSnapshotRoundtrip:
 
         asyncio.run(go())
 
+    def test_truncated_snapshot_rejected_cheaply(self, tmp_path):
+        """ISSUE 9 satellite: a half-written file — whether it breaks the
+        JSON or survives as valid-but-short JSON — is rejected by the
+        version/crc32 envelope, not by an arbitrary exception inside
+        restore()."""
+        path = str(tmp_path / "torn.snap")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            write_snapshot(bal, path)
+            raw = open(path).read()
+            # torn write: drop the tail (broken JSON)
+            open(path, "w").write(raw[: len(raw) // 2])
+            cold = _balancer(provider, "1")
+            torn_ok = load_snapshot(cold, path)
+            # bit rot that KEEPS valid JSON: flip a payload value — only
+            # the crc can catch this one
+            doc = json.loads(raw)
+            doc["free_mb"] = [v + 1 for v in doc["free_mb"]]
+            json.dump(doc, open(path, "w"))
+            rot_ok = load_snapshot(cold, path)
+            await bal.close()
+            await cold.close()
+            for inv in invokers:
+                await inv.stop()
+            return torn_ok, rot_ok
+
+        torn_ok, rot_ok = asyncio.run(go())
+        assert torn_ok is False, "torn snapshot must cold-start"
+        assert rot_ok is False, "crc-failing snapshot must cold-start"
+
     def test_stale_cluster_size_yields_to_topology(self, tmp_path):
         """A snapshot from a 1-controller deployment restored into a
         2-controller topology must re-shard to the OPERATOR's cluster size

@@ -1,0 +1,600 @@
+"""The HA plane, tier-1 half (ISSUE 9): write-ahead placement journal
+framing/corruption posture, deterministic snapshot+journal replay parity
+(the NumPy/CPU-twin re-execution of the recorded packed steps must
+re-derive bit-identical books AND the journaled decisions), epoch-fenced
+leadership, the invoker's zombie-batch fence, and the standby refusal
+path. The kill-mid-burst chaos proof lives in tests/test_ha_chaos.py
+(slow); everything here is in-process and fast."""
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from openwhisk_tpu.controller.loadbalancer import (LoadBalancerException,
+                                                   TpuBalancer)
+from openwhisk_tpu.controller.loadbalancer.checkpoint import (
+    BalancerSnapshotter, load_snapshot, write_snapshot)
+from openwhisk_tpu.controller.loadbalancer.journal import (PlacementJournal,
+                                                           journal_from_config)
+from openwhisk_tpu.controller.loadbalancer.membership import \
+    ControllerMembership
+from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+
+from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+
+def _balancer(provider, instance="0", **kw):
+    return TpuBalancer(provider, ControllerInstanceId(instance),
+                       managed_fraction=1.0, blackbox_fraction=0.0, **kw)
+
+
+class TestJournalFraming:
+    def test_roundtrip_rotation_prune_and_lag(self, tmp_path):
+        j = PlacementJournal(str(tmp_path), segment_bytes=256, fsync_batch=2)
+        for s in range(1, 40):
+            j.append({"t": "x", "seq": s})
+        assert j.flush()
+        assert j.lag_batches == 0
+        assert [r["seq"] for r in j.records(0)] == list(range(1, 40))
+        assert [r["seq"] for r in j.records(30)] == list(range(31, 40))
+        assert j.last_seq() == 39
+        segs = j._segments()
+        assert len(segs) > 3, "segment rotation must split the log"
+        # prune everything a seq-20 snapshot covers; the tail must survive
+        assert j.prune(20) >= 1
+        assert [r["seq"] for r in j.records(20)] == list(range(21, 40))
+        j.close()
+
+    def test_fsync_p99_and_gauges(self, tmp_path):
+        from openwhisk_tpu.utils.logging import MetricEmitter
+        j = PlacementJournal(str(tmp_path))
+        j.append({"t": "x", "seq": 1})
+        assert j.flush()
+        m = MetricEmitter()
+        j.export_gauges(m)
+        assert m.gauge_value("loadbalancer_journal_lag_batches") == 0
+        assert m.gauge_value("loadbalancer_journal_bytes") > 0
+        assert m.gauge_value("loadbalancer_journal_fsync_p99_ms") is not None
+        j.close()
+
+    def test_config_off_switch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CONFIG_whisk_ha_journal_enabled", "false")
+        assert journal_from_config(str(tmp_path)) is None
+        monkeypatch.setenv("CONFIG_whisk_ha_journal_enabled", "true")
+        j = journal_from_config(str(tmp_path))
+        assert j is not None
+        j.close()
+
+
+class TestJournalCorruption:
+    """Satellite: a CRC-failing or half-written tail record truncates the
+    journal at the last good frame and logs — never aborts boot."""
+
+    def _write(self, tmp_path, n=10):
+        j = PlacementJournal(str(tmp_path), fsync_batch=1)
+        for s in range(1, n + 1):
+            j.append({"t": "x", "seq": s})
+        assert j.flush()
+        j.close()
+        segs = sorted(p for p in os.listdir(tmp_path) if p.endswith(".seg"))
+        return os.path.join(str(tmp_path), segs[-1])
+
+    def test_torn_tail_truncates_at_last_good_frame(self, tmp_path):
+        path = self._write(tmp_path)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 3)  # half-written record
+        j = PlacementJournal(str(tmp_path))
+        assert [r["seq"] for r in j.records(0)] == list(range(1, 10))
+        # appending resumes after the torn frame is cut, seqs stay unique
+        j.append({"t": "x", "seq": 10})
+        assert j.flush()
+        assert j.last_seq() == 10
+        j.close()
+
+    def test_crc_flip_truncates(self, tmp_path):
+        path = self._write(tmp_path)
+        data = bytearray(open(path, "rb").read())
+        data[-2] ^= 0xFF  # corrupt the last record's payload
+        open(path, "wb").write(bytes(data))
+        j = PlacementJournal(str(tmp_path))
+        recs = list(j.records(0))
+        assert [r["seq"] for r in recs] == list(range(1, 10))
+        j.close()
+
+    def test_mid_log_corruption_stops_replay_there(self, tmp_path):
+        path = self._write(tmp_path)
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        j = PlacementJournal(str(tmp_path))
+        recs = list(j.records(0))
+        # a prefix replays; nothing after the corruption is trusted
+        assert recs and recs[-1]["seq"] < 10
+        j.close()
+
+    def test_zombie_flush_lands_in_own_segment_and_replay_drops_it(
+            self, tmp_path):
+        """Review regression: a paused-then-resumed zombie active may
+        flush an already-buffered batch AFTER a standby claimed the next
+        epoch. The promoted active always appends into a FRESH segment,
+        so the late write cannot interleave with (CRC-corrupt) the new
+        epoch's frames — and replay drops the stale-epoch records."""
+        zombie = PlacementJournal(str(tmp_path), fsync_batch=1)
+        for s in range(1, 11):
+            zombie.append({"t": "x", "seq": s, "epoch": 1})
+        assert zombie.flush()
+        # promotion: the new active read 1..10 and continues under epoch 2
+        active = PlacementJournal(str(tmp_path), fsync_batch=1)
+        assert active.last_seq() == 10
+        for s in range(11, 16):
+            active.append({"t": "x", "seq": s, "epoch": 2})
+        assert active.flush()
+        # the zombie resumes and flushes overlapping-seq stale frames
+        for s in range(11, 14):
+            zombie.append({"t": "x", "seq": s, "epoch": 1})
+        assert zombie.flush()
+        zombie.close()
+        active.close()
+        # every frame of BOTH epochs is still intact on disk (no corrupt
+        # interleave), and the new epoch's full tail is readable
+        recs = list(PlacementJournal(str(tmp_path)).records(0))
+        epoch2 = [r["seq"] for r in recs if r.get("epoch") == 2]
+        assert epoch2 == [11, 12, 13, 14, 15]
+
+        # the balancer's replay drops the zombie's stale-epoch records
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            stats = bal.replay_journal(recs)
+            await bal.close()
+            return stats
+
+        stats = asyncio.run(go())
+        assert stats["stale_epoch_dropped"] == 3
+
+    def test_torn_old_epoch_segment_does_not_hide_newer_epoch(
+            self, tmp_path):
+        """A tear at the end of the dead epoch's segment (its crash) must
+        not swallow the NEW epoch's later segment: replay continues across
+        the gap exactly when the next segment opens a higher epoch."""
+        old = PlacementJournal(str(tmp_path), fsync_batch=1)
+        for s in range(1, 6):
+            old.append({"t": "x", "seq": s, "epoch": 1})
+        assert old.flush()
+        old.close()
+        new = PlacementJournal(str(tmp_path), fsync_batch=1)
+        for s in range(6, 9):
+            new.append({"t": "x", "seq": s, "epoch": 2})
+        assert new.flush()
+        new.close()
+        segs = sorted(p for p in os.listdir(tmp_path) if p.endswith(".seg"))
+        assert len(segs) == 2, "each writer must own its own segment"
+        first = os.path.join(str(tmp_path), segs[0])
+        with open(first, "r+b") as f:
+            f.truncate(os.path.getsize(first) - 3)  # zombie died mid-write
+        recs = list(PlacementJournal(str(tmp_path)).records(0))
+        assert [r["seq"] for r in recs] == [1, 2, 3, 4, 6, 7, 8]
+
+    def test_unknown_record_type_skipped_not_fatal(self, tmp_path):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            stats = bal.replay_journal(
+                [{"t": "from_the_future", "seq": 1}])
+            await bal.close()
+            return stats
+
+        stats = asyncio.run(go())
+        assert stats["replayed"] == 1 and stats["last_seq"] == 1
+
+
+class TestSnapshotHardening:
+    """Satellite: version + CRC32 on the snapshot envelope; torn or
+    tampered files are rejected cheaply (cold start, never an abort)."""
+
+    def test_snapshot_carries_version_and_crc(self, tmp_path):
+        path = str(tmp_path / "bal.snap")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            write_snapshot(bal, path)
+            await bal.close()
+
+        asyncio.run(go())
+        doc = json.load(open(path))
+        assert doc["version"] >= 2 and isinstance(doc["crc32"], int)
+
+    def test_tampered_payload_rejected(self, tmp_path):
+        path = str(tmp_path / "bal.snap")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            write_snapshot(bal, path)
+            doc = json.load(open(path))
+            doc["n_pad"] = doc["n_pad"] * 2  # bit rot with intact JSON
+            json.dump(doc, open(path, "w"))
+            cold = _balancer(provider, "1")
+            ok = load_snapshot(cold, path)
+            await bal.close()
+            await cold.close()
+            return ok
+
+        assert asyncio.run(go()) is False
+
+
+class TestReplayParity:
+    """Tentpole acceptance, fast half: snapshot + journal-tail replay
+    re-derives bit-identical books on the CPU twin (deterministic kernel
+    re-execution), and the re-derived decisions match the journaled
+    readbacks (parity_mismatches == 0)."""
+
+    def test_snapshot_plus_tail_replay_rebuilds_books_bit_exact(
+            self, tmp_path):
+        snap = str(tmp_path / "bal.snap")
+        jdir = str(tmp_path / "wal")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.attach_journal(PlacementJournal(jdir))
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4, delay=0.4)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            actions = [make_action(f"jr{i}", memory=128 + 128 * (i % 2))
+                       for i in range(3)]
+            # wave 1 holds, snapshot mid-life, wave 2 holds + completions
+            # (so the journal tail carries batch, ack AND fold records)
+            p1 = [await bal.publish(a, make_msg(a, ident, True))
+                  for a in actions for _ in range(3)]
+            write_snapshot(bal, snap)
+            p2 = [await bal.publish(a, make_msg(a, ident, True))
+                  for a in actions for _ in range(2)]
+            await asyncio.gather(*[asyncio.wait_for(p, 10) for p in p1 + p2])
+            for _ in range(50):  # quiesce: all releases folded
+                if not (bal._pending or bal._releases
+                        or bal._inflight_steps):
+                    break
+                await asyncio.sleep(0.1)
+            await asyncio.sleep(0.3)
+            assert bal.journal.flush()
+
+            cold = _balancer(provider, "1")
+            reader = PlacementJournal(jdir)
+            snap_doc = json.load(open(snap))
+            cold.restore(snap_doc)
+            stats = cold.replay_journal(
+                reader.records(snap_doc["journal_seq"]),
+                from_seq=snap_doc["journal_seq"])
+            same_free = np.array_equal(np.asarray(cold.state.free_mb),
+                                       np.asarray(bal.state.free_mb))
+            same_conc = np.array_equal(np.asarray(cold.state.conc_free),
+                                       np.asarray(bal.state.conc_free))
+            regs = [i.instance for i in cold._registry]
+            await bal.close()
+            await cold.close()
+            for inv in invokers:
+                await inv.stop()
+            return same_free, same_conc, stats, regs
+
+        same_free, same_conc, stats, regs = asyncio.run(go())
+        assert same_free, "memory books must replay bit-exact"
+        assert same_conc, "concurrency books must replay bit-exact"
+        assert stats["batches"] >= 1, "the tail must contain real batches"
+        assert stats["parity_mismatches"] == 0, \
+            "re-derived decisions must equal the journaled readback"
+        assert regs == [0, 1, 2, 3]
+
+    def test_full_history_replay_without_snapshot(self, tmp_path):
+        """A journal whose first record is seq 1 can rebuild the books
+        from nothing (registration records included)."""
+        jdir = str(tmp_path / "wal")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.attach_journal(PlacementJournal(jdir))
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2, delay=0.3)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("jfull", memory=256)
+            ps = [await bal.publish(action, make_msg(action, ident, True))
+                  for _ in range(4)]
+            await asyncio.gather(*[asyncio.wait_for(p, 10) for p in ps])
+            for _ in range(50):
+                if not (bal._pending or bal._releases
+                        or bal._inflight_steps):
+                    break
+                await asyncio.sleep(0.1)
+            await asyncio.sleep(0.3)
+            assert bal.journal.flush()
+            cold = _balancer(provider, "1")
+            ok = load_snapshot(cold, str(tmp_path / "missing.snap"),
+                               journal=PlacementJournal(jdir))
+            same = np.array_equal(np.asarray(cold.state.free_mb),
+                                  np.asarray(bal.state.free_mb))
+            regs = [i.instance for i in cold._registry]
+            await bal.close()
+            await cold.close()
+            for inv in invokers:
+                await inv.stop()
+            return ok, same, regs
+
+        ok, same, regs = asyncio.run(go())
+        assert ok is False, "no snapshot file: load reports a cold start"
+        assert same, "…but the full-history journal rebuilt the books"
+        assert regs == [0, 1]
+
+    def test_journal_off_is_bitexact_noop(self, tmp_path):
+        """Acceptance: the off path (no attached journal) behaves exactly
+        like today — no records, no seq movement, snapshot unchanged
+        modulo the version/crc envelope."""
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("joff", memory=256)
+            ps = [await bal.publish(action, make_msg(action, ident, True))
+                  for _ in range(4)]
+            await asyncio.gather(*[asyncio.wait_for(p, 10) for p in ps])
+            seq = bal._journal_seq
+            snap = bal.snapshot()
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return seq, snap
+
+        seq, snap = asyncio.run(go())
+        assert seq == 0 and snap["journal_seq"] == 0
+
+
+class TestLeadership:
+    """Epoch-fenced active/standby over the bus (membership.py)."""
+
+    def _membership(self, provider, i, events, heartbeat=0.05, timeout=0.25):
+        class BalancerStub:
+            cluster_size = 2
+            metrics = None
+
+            def update_cluster(self, n):
+                self.cluster_size = n
+
+        async def cb(epoch, active):
+            events[i].append((epoch, active))
+
+        m = ControllerMembership(provider, ControllerInstanceId(str(i)),
+                                 BalancerStub(), heartbeat_s=heartbeat,
+                                 member_timeout_s=timeout, ha=True,
+                                 on_leadership=cb)
+        m.start()
+        return m
+
+    def test_lowest_live_claims_then_standby_takes_over_with_higher_epoch(
+            self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            events = {0: [], 1: []}
+            m0 = self._membership(provider, 0, events)
+            m1 = self._membership(provider, 1, events)
+            await asyncio.sleep(1.0)
+            assert m0.is_active and not m1.is_active
+            assert m0.leadership_epoch == 1 == m1.leadership_epoch
+            # hard death: no leave, just silence
+            await m0._ticker.stop()
+            await m0._feed.stop()
+            for _ in range(100):
+                if m1.is_active:
+                    break
+                await asyncio.sleep(0.05)
+            assert m1.is_active and m1.leadership_epoch == 2
+            assert events[0] == [(1, True)]
+            assert events[1] == [(2, True)]
+            await m1.stop()
+            return True
+
+        assert asyncio.run(go())
+
+    def test_rejoined_old_active_stays_standby_and_zombie_demotes(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            events = {0: [], 1: []}
+            m1 = self._membership(provider, 1, events)
+            await asyncio.sleep(0.8)
+            assert m1.is_active and m1.leadership_epoch == 1
+            # instance 0 joins late: lower instance, but epoch 1 is already
+            # claimed and alive — it must NOT steal the leadership
+            m0 = self._membership(provider, 0, events)
+            await asyncio.sleep(0.8)
+            assert m1.is_active and not m0.is_active
+            assert m0.leadership_epoch == 1
+            # zombie demotion: a forged higher-epoch claim supersedes
+            m1._observe_claim(5, 0)
+            assert not m1.is_active and m1.leadership_epoch == 5
+            await asyncio.sleep(0.1)  # the demotion callback is spawned
+            assert events[1][-1] == (5, False)
+            await m0.stop()
+            await m1.stop()
+            return True
+
+        assert asyncio.run(go())
+
+
+class TestStandbyAndFencing:
+    def test_standby_refuses_publish_until_promoted(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("stby", memory=256)
+            bal.set_leadership(0, False)
+            with pytest.raises(LoadBalancerException):
+                await bal.publish(action, make_msg(action, ident, True))
+            bal.set_leadership(3, True)
+            p = await bal.publish(action, make_msg(action, ident, True))
+            await asyncio.wait_for(p, 10)
+            await asyncio.sleep(0.1)
+            # the dispatched message carries the fencing epoch
+            fences = [m.fence_epoch for inv in invokers
+                      for m in inv.handled]
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return fences
+
+        fences = asyncio.run(go())
+        assert fences and all(f == 3 for f in fences)
+
+    def test_standby_snapshotter_never_dumps(self, tmp_path):
+        path = str(tmp_path / "standby.snap")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.set_leadership(0, False)
+            snap = BalancerSnapshotter(bal, path, interval=0.03).start()
+            await asyncio.sleep(0.2)
+            await snap.stop(final_dump=True)
+            exists_standby = os.path.exists(path)
+            # promoted: the same snapshotter wiring dumps again
+            bal.set_leadership(1, True)
+            snap2 = BalancerSnapshotter(bal, path, interval=0.03).start()
+            for _ in range(100):
+                if os.path.exists(path):
+                    break
+                await asyncio.sleep(0.02)
+            await snap2.stop()
+            exists_active = os.path.exists(path)
+            await bal.close()
+            return exists_standby, exists_active
+
+        exists_standby, exists_active = asyncio.run(go())
+        assert not exists_standby, \
+            "a standby must never clobber the active's snapshot"
+        assert exists_active
+
+    def test_invoker_discards_fenced_epoch_messages(self, tmp_path):
+        """The no-double-placement half of failover: an invoker that has
+        seen epoch N discards activations stamped with an older epoch (a
+        zombie active's late batch)."""
+        from openwhisk_tpu.containerpool import ContainerPoolConfig
+        from openwhisk_tpu.core.entity import (ActivationId, ExecManifest,
+                                               InvokerInstanceId, MB)
+        from openwhisk_tpu.database import (ArtifactActivationStore,
+                                            EntityStore, MemoryArtifactStore)
+        from openwhisk_tpu.invoker.reactive import InvokerReactive
+        from openwhisk_tpu.messaging import ActivationMessage
+        from openwhisk_tpu.utils.transaction import TransactionId
+
+        async def go():
+            ExecManifest.initialize()
+            provider = MemoryMessagingProvider()
+            store = MemoryArtifactStore()
+
+            class FactoryStub:
+                async def cleanup(self):
+                    pass
+
+            inv = InvokerReactive(
+                InvokerInstanceId(0, user_memory=MB(1024)), provider,
+                EntityStore(store), ArtifactActivationStore(store),
+                FactoryStub(),
+                pool_config=ContainerPoolConfig(user_memory=MB(1024)))
+
+            released = []
+
+            class FeedStub:
+                def processed(self):
+                    released.append(1)
+
+            ident = Identity.generate("guest")
+            action = make_action("fence", memory=128)
+
+            def payload(epoch):
+                return ActivationMessage(
+                    TransactionId(), action.fully_qualified_name, None,
+                    ident, ActivationId.generate(),
+                    ControllerInstanceId("0"), False, {},
+                    fence_epoch=epoch).serialize()
+
+            # adopt epoch 4, then a zombie epoch-2 batch arrives: discarded
+            # without ever reaching the action-fetch path
+            await inv._process(payload(4), FeedStub())
+            assert inv._max_fence_epoch == 4
+            before = len(released)
+            await inv._process(payload(2), FeedStub())
+            discarded = inv.fenced_discards
+            assert len(released) == before + 1, \
+                "a discarded message must still release feed capacity"
+            # unfenced traffic (non-HA) is untouched by the fence
+            await inv._process(ActivationMessage(
+                TransactionId(), action.fully_qualified_name, None, ident,
+                ActivationId.generate(), ControllerInstanceId("0"), False,
+                {}).serialize(), FeedStub())
+            assert inv._max_fence_epoch == 4
+            return discarded
+
+        assert asyncio.run(go()) == 1
+
+    def test_standalone_shutdown_writes_final_dump(self, tmp_path):
+        """Satellite: the standalone assembly wires snapshot + journal
+        through Controller.owned_resources, so a clean shutdown (the
+        SIGTERM path ends in controller.stop()) always writes the final
+        dump — a restart then replays no journal at all."""
+        import socket
+
+        from openwhisk_tpu.standalone import make_standalone
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        snap = str(tmp_path / "sa.snap")
+        jdir = str(tmp_path / "wal")
+
+        async def go():
+            controller = await make_standalone(
+                port=port, balancer="tpu", ui=False,
+                snapshot_path=snap, snapshot_interval=60.0,
+                journal_dir=jdir)
+            bal = controller.load_balancer
+            assert bal.journal is not None
+            # interval is 60 s: only the shutdown path can write this file
+            assert not os.path.exists(snap)
+            await controller.stop()
+            return os.path.exists(snap)
+
+        assert asyncio.run(go()), "controller.stop() must write the dump"
+        doc = json.load(open(snap))
+        assert doc["registry"], "final dump carries the live fleet"
+        assert doc["version"] >= 2
+
+    def test_fence_epoch_wire_roundtrip_and_absent_by_default(self):
+        from openwhisk_tpu.core.entity import ActivationId
+        from openwhisk_tpu.messaging import ActivationMessage
+        from openwhisk_tpu.utils.transaction import TransactionId
+        ident = Identity.generate("guest")
+        action = make_action("wire", memory=128)
+        plain = ActivationMessage(
+            TransactionId(), action.fully_qualified_name, None, ident,
+            ActivationId.generate(), ControllerInstanceId("0"), False, {})
+        assert "fenceEpoch" not in plain.to_json(), \
+            "the non-HA wire format must stay byte-identical"
+        assert ActivationMessage.parse(plain.serialize()).fence_epoch is None
+        fenced = ActivationMessage(
+            TransactionId(), action.fully_qualified_name, None, ident,
+            ActivationId.generate(), ControllerInstanceId("0"), False, {},
+            fence_epoch=7)
+        assert ActivationMessage.parse(fenced.serialize()).fence_epoch == 7

@@ -145,10 +145,12 @@ class TestExpositionFormat:
                 "# TYPE f histogram\n"
                 'f_bucket{le="1"} 5\nf_bucket{le="+Inf"} 3\nf_count 3\n')
 
-    def test_live_metrics_page_is_valid(self):
+    def test_live_metrics_page_is_valid(self, tmp_path):
         from openwhisk_tpu.controller.core import Controller
 
         async def go():
+            from openwhisk_tpu.controller.loadbalancer.journal import \
+                PlacementJournal
             from openwhisk_tpu.utils.logging import NullLogging
             provider = MemoryMessagingProvider()
             # share one emitter between balancer and controller, the way
@@ -158,6 +160,10 @@ class TestExpositionFormat:
             bal = TpuBalancer(provider, ControllerInstanceId("0"),
                               logger=logger, metrics=logger.metrics,
                               managed_fraction=1.0, blackbox_fraction=0.0)
+            # the HA plane's families (ISSUE 9): a live journal + an
+            # adopted leadership epoch must render on the same page
+            bal.attach_journal(PlacementJournal(str(tmp_path / "wal")))
+            bal.set_leadership(2, True)
             controller = Controller(ControllerInstanceId("0"), provider,
                                     logger=logger, load_balancer=bal)
             ident = Identity.generate("guest")
@@ -182,6 +188,10 @@ class TestExpositionFormat:
                 await asyncio.sleep(0.3)
                 bal.telemetry.device_fold()
                 bal.telemetry.tick(bal.metrics)  # slo_* gauges on the page
+                # journal gauges normally ride the supervision tick;
+                # refresh them deterministically for the scrape
+                bal.journal.flush()
+                bal.journal.export_gauges(bal.metrics)
                 # anomaly plane: two ticks (the device path harvests its
                 # scores one tick late), then inject a synthetic firing
                 # alert so all three new families render. Alert evaluation
@@ -269,6 +279,14 @@ class TestExpositionFormat:
                 'transition="firing"} 1') in text
         # tracing health gauges (satellite: orphan finishes are visible)
         assert types["openwhisk_tracing_orphan_finishes"] == "gauge"
+        # the HA plane's families (ISSUE 9): journal durability lag /
+        # size / fsync tail + the adopted leadership epoch
+        assert types["openwhisk_loadbalancer_journal_lag_batches"] == "gauge"
+        assert types["openwhisk_loadbalancer_journal_bytes"] == "gauge"
+        assert types[
+            "openwhisk_loadbalancer_journal_fsync_p99_ms"] == "gauge"
+        assert types["openwhisk_controller_leadership_epoch"] == "gauge"
+        assert "openwhisk_controller_leadership_epoch 2" in text
         # the latency-waterfall plane's families (ISSUE 7): per-stage e2e
         # timing as a REAL histogram family — the grammar pass above
         # already proved names, label escaping and monotone cumulative
