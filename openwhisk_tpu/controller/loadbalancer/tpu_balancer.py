@@ -94,9 +94,22 @@ class PlacementPathConfig:
       harnesses that can't tolerate background-compile GIL hiccups.
     """
     placement_kernel: str = "auto"   # scan | repair | auto
+    #: kernel: the device BACKEND (xla | pallas | auto) — orthogonal to
+    #: placement_kernel; "auto" resolves by cached measured rate (see
+    #: calibrate_kernel) with resolve_auto_kernel as the pre-calibration
+    #: guess. Constructor argument overrides the env, like the rest.
+    kernel: str = "auto"             # xla | pallas | auto
     donate_state: bool = True
     ring_assembly: bool = True
     prewarm: bool = True
+    #: calibrate_kernel: how `kernel="auto"` picks the device backend
+    #: (xla vs pallas). "auto" (default): on a real TPU, a one-shot cached
+    #: per-bucket-shape microbench rides the prewarm drainer and the
+    #: MEASURED packed-step rate picks the backend (never on the event
+    #: loop; on non-TPU backends the static resolver stands — pallas only
+    #: has interpret mode there). "force": calibrate even on the CPU twin
+    #: (tests / bench's auto_pick row). "off": static resolver only.
+    calibrate_kernel: str = "auto"   # auto | force | off
     #: adaptive_window: under arrival pressure, trade a bounded
     #: accumulation delay (ADAPTIVE_WINDOW_MS) for bigger micro-batches
     #: instead of eager per-arrival dispatch. An idle or slow-trickle
@@ -119,18 +132,243 @@ def _mod_inverse(step: int, m: int) -> int:
 
 
 def resolve_auto_kernel(n_pad: int, action_slots: int) -> str:
-    """The kernel="auto" policy, shared with bench.py's headline selection:
-    the pallas schedule on real TPU hardware when the (n_pad, action_slots)
-    state fits its VMEM budget — across rounds it matches the XLA kernel's
-    median rate with 3-5x lower run-to-run spread (r04: pallas 3.58M/s
-    +-12% vs xla 2.13M/s +-69%; BASELINE.md) at bit-exact parity. On
-    non-TPU backends pallas only has interpret mode (a debugging path,
-    orders of magnitude slower), and past the VMEM budget only the XLA
-    kernel scales — both resolve to "xla"."""
+    """The STATIC half of the kernel="auto" policy, shared with bench.py's
+    headline selection: the pallas schedule on real TPU hardware when the
+    (n_pad, action_slots) state fits its VMEM budget — across rounds it
+    matches the XLA kernel's median rate with 3-5x lower run-to-run spread
+    (r04: pallas 3.58M/s +-12% vs xla 2.13M/s +-69%; BASELINE.md) at
+    bit-exact parity. On non-TPU backends pallas only has interpret mode
+    (a debugging path, orders of magnitude slower), and past the VMEM
+    budget only the XLA kernel scales — both resolve to "xla".
+
+    This is only the pre-calibration guess: once the prewarm drainer's
+    calibration microbench has MEASURED both backends at a live bucket
+    shape (`calibrate_backend_rates`), the cached measured rate replaces
+    this heuristic as the tiebreak (`cached_backend_choice`)."""
     if jax.default_backend() != "tpu":
         return "xla"
     from ...ops.placement_pallas import fits_vmem
     return "pallas" if fits_vmem(n_pad, action_slots) else "xla"
+
+
+#: batch-bucket width from which placement_kernel="auto" swaps the scan
+#: program for the speculate-and-repair kernel (either backend). Below it
+#: the scan both EXECUTES fine (a handful of sequential probe steps) and
+#: COMPILES ~3x faster (~0.45 s vs ~1.2 s per bucket signature on a dev
+#: box) — and compile latency is what light traffic actually feels, since
+#: a new bucket shape jit-compiles inside a live dispatch. At and above it
+#: the scan's B-length dependency chain dominates and repair wins outright.
+REPAIR_MIN_BATCH = 32
+#: on the CPU twin the repair program's per-round vector work (a full
+#: [B, N] re-speculation plus [A]-wide conflict scatters) is real compute,
+#: not free dispatch slack — below this fleet padding the scan's short
+#: dependency chain is cheaper than one repair round (measured ~4x at
+#: N=64, B<=64), so XLA "auto" additionally requires fleet >= this on CPU.
+#: Irrelevant on devices, where both programs are dispatch-bound at these
+#: shapes.
+REPAIR_MIN_FLEET_CPU = 256
+
+
+def _xla_pair(placement_kernel: str):
+    """(schedule_fn, release_fn, resolved_kernel) for the XLA backend,
+    honoring the placement-kernel knob. "repair" pins the speculate-and-
+    repair schedule + vectorized release fold at every size; "scan" keeps
+    the reference lax.scan pair (the true-no-op legacy path); "auto" picks
+    PER BUCKET — batch/release widths are static per jit signature, so the
+    branch resolves at trace time and each compiled program contains
+    exactly one kernel: scan below REPAIR_MIN_BATCH, repair at and above
+    it. All pairs are bit-exact (the fuzz suite asserts it), so the knob
+    only moves compile/run cost, never placements."""
+    if placement_kernel == "repair":
+        return schedule_batch_repair, release_batch_vector, "repair"
+    if placement_kernel == "auto":
+        threshold = REPAIR_MIN_BATCH
+        min_fleet = (REPAIR_MIN_FLEET_CPU
+                     if jax.default_backend() == "cpu" else 0)
+
+        def auto_schedule(state, batch):
+            # both shapes are static at trace time
+            if (batch.valid.shape[0] >= threshold
+                    and state.free_mb.shape[0] >= min_fleet):
+                return schedule_batch_repair(state, batch)
+            return schedule_batch(state, batch)
+
+        def auto_release(state, inv, slot, need_mb, max_conc, valid):
+            if (inv.shape[0] >= threshold
+                    and state.free_mb.shape[0] >= min_fleet):
+                return release_batch_vector(state, inv, slot, need_mb,
+                                            max_conc, valid)
+            return release_batch(state, inv, slot, need_mb, max_conc,
+                                 valid)
+
+        auto_schedule._placement_hybrid = True
+        auto_release._placement_hybrid = True
+        return auto_schedule, auto_release, "repair"
+    return schedule_batch, release_batch, "scan"
+
+
+def _pallas_pair(placement_kernel: str):
+    """(schedule_fn, release_fn, resolved_kernel) for the pallas backend.
+    "scan" is the PR-4 VMEM-resident sequential kernel; "repair" is the
+    fused speculate-and-repair kernel (`schedule_batch_repair_pallas`) —
+    probe + conflict detect + commit + the residue loop in ONE pallas_call
+    with the books resident in VMEM, sharing the conflict rules with the
+    XLA kernel so the two cannot drift; "auto" is the same per-bucket
+    static-branch hybrid as the XLA pair (scan below REPAIR_MIN_BATCH).
+    The kernel layout is conc-transposed; state everywhere else stays
+    [N, A] — converting inside jit keeps both transposes on-device in the
+    same program as the kernel call. The release fold is the XLA pair's
+    (it fuses into the same program around the pallas call)."""
+    from ...ops.placement_pallas import (schedule_batch_pallas,
+                                         schedule_batch_repair_pallas,
+                                         to_transposed)
+    interpret = jax.default_backend() == "cpu"
+
+    @jax.jit
+    def sched_scan(st, batch):
+        ts, chosen, forced = schedule_batch_pallas(
+            to_transposed(st), batch, interpret=interpret)
+        return (PlacementState(ts.free_mb, ts.conc_free.T, ts.health),
+                chosen, forced)
+
+    @jax.jit
+    def sched_repair(st, batch):
+        ts, chosen, forced, rounds = schedule_batch_repair_pallas(
+            to_transposed(st), batch, interpret=interpret)
+        return (PlacementState(ts.free_mb, ts.conc_free.T, ts.health),
+                chosen, forced, rounds)
+
+    sched_scan._pallas_kind = "scan"
+    sched_repair._pallas_kind = "repair"
+    if placement_kernel == "scan":
+        return sched_scan, release_batch, "scan"
+    if placement_kernel == "repair":
+        return sched_repair, release_batch_vector, "repair"
+    threshold = REPAIR_MIN_BATCH
+
+    def auto_schedule(state, batch):
+        if batch.valid.shape[0] >= threshold:
+            return sched_repair(state, batch)
+        return sched_scan(state, batch)
+
+    def auto_release(state, inv, slot, need_mb, max_conc, valid):
+        if inv.shape[0] >= threshold:
+            return release_batch_vector(state, inv, slot, need_mb,
+                                        max_conc, valid)
+        return release_batch(state, inv, slot, need_mb, max_conc, valid)
+
+    auto_schedule._placement_hybrid = True
+    auto_schedule._pallas_kind = "auto"
+    auto_release._placement_hybrid = True
+    return auto_schedule, auto_release, "repair"
+
+
+#: one-shot calibration results: (platform, n_pad, action_slots,
+#: placement_kernel, R, H, B) -> {"rates": {...}, "winner": ...}. Module-
+#: level on purpose — a restarted balancer (or a standby promoting) with
+#: the same geometry adopts the measured choice without re-benching.
+_KERNEL_CALIBRATION: Dict[tuple, dict] = {}
+
+#: a backend must measure this much faster to displace the incumbent —
+#: damps flip-flopping between buckets whose rates are within noise
+CALIBRATION_HYSTERESIS = 1.1
+
+
+def _calibration_batch_buffer(n_pad: int, action_slots: int, r: int, h: int,
+                              b: int) -> np.ndarray:
+    """A packed (rel ++ health ++ req) buffer for the calibration
+    microbench: a realistic all-valid batch over the whole (healthy) pad —
+    memory-dominant traffic with spread homes/slots, the production bulk
+    the kernels are picked for."""
+    rng = np.random.RandomState(1234)
+    rel = np.zeros((5, r), np.int32)
+    rel[3] = 1  # padded rows: maxc=1
+    health = np.zeros((3, h), np.int32)
+    req = np.zeros((9, b), np.int32)
+    req[1] = n_pad                       # size: the whole pad
+    req[2] = rng.randint(0, n_pad, b)    # home
+    req[3] = 1                           # step_inv (step 1 is coprime)
+    req[4] = 128                         # need_mb
+    req[5] = rng.randint(0, max(1, min(64, action_slots)), b)
+    req[6] = 1                           # max_conc
+    req[7] = rng.randint(0, n_pad, b)    # rand
+    req[8] = 1                           # valid
+    return np.concatenate([rel.ravel(), health.ravel(), req.ravel()])
+
+
+def calibrate_backend_rates(n_pad: int, action_slots: int, r: int, h: int,
+                            b: int, *, placement_kernel: str = "auto",
+                            include_pallas: bool = True, iters: int = 4,
+                            warmup: int = 1, use_cache: bool = True) -> dict:
+    """The kernel="auto" tiebreak: measure the fused packed step's rate for
+    both device backends at ONE bucket signature and cache the result
+    (one-shot per shape — `_KERNEL_CALIBRATION`). Runs wherever the caller
+    is (the balancer calls it on the prewarm drainer thread, bench.py's
+    auto_pick row inline); compiles its own non-donated fn instances, so
+    it never touches a live balancer's jit caches or donated buffers. The
+    plain (non-admit) step is measured even when device rate-admission is
+    on: the admission fold is identical XLA on both backends, so the
+    relative rate is what matters. A backend that fails to build or run
+    reports a null rate and simply cannot win."""
+    platform = jax.default_backend()
+    key = (platform, n_pad, action_slots, placement_kernel, r, h, b)
+    if use_cache:
+        hit = _KERNEL_CALIBRATION.get(key)
+        if hit is not None:
+            return hit
+    buf = _calibration_batch_buffer(n_pad, action_slots, r, h, b)
+    rates: Dict[str, Optional[float]] = {}
+    errors: Dict[str, str] = {}
+    backends = ["xla"] + (["pallas"] if include_pallas else [])
+    for backend in backends:
+        try:
+            sched, release, _ = (_pallas_pair if backend == "pallas"
+                                 else _xla_pair)(placement_kernel)
+            fn = make_fused_step_packed(release, sched)
+            state = init_state(n_pad, [1 << 20] * n_pad, n_pad=n_pad,
+                               action_slots=action_slots)
+            out = None
+            for _ in range(max(1, warmup)):
+                _st, out = fn(state, buf, r, h, b)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(max(1, iters)):
+                _st, out = fn(state, buf, r, h, b)
+                jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            rates[backend] = round(b * max(1, iters) / dt, 1)
+        except Exception as e:  # noqa: BLE001 — a backend that cannot run
+            # cannot win; the caller sees why in `errors`
+            rates[backend] = None
+            errors[backend] = repr(e)
+    live = {k: v for k, v in rates.items() if v}
+    winner = max(live, key=live.get) if live else "xla"
+    if (winner == "pallas" and live.get("xla")
+            and live["pallas"] < live["xla"] * CALIBRATION_HYSTERESIS):
+        winner = "xla"  # incumbent keeps ties-within-noise
+    out = {"rates": rates, "winner": winner, "platform": platform,
+           "n_pad": n_pad, "action_slots": action_slots,
+           "placement_kernel": placement_kernel, "sig": [r, h, b],
+           "iters": iters}
+    if errors:
+        out["errors"] = errors
+    _KERNEL_CALIBRATION[key] = out
+    return out
+
+
+def cached_backend_choice(n_pad: int, action_slots: int,
+                          placement_kernel: str) -> Optional[str]:
+    """The cached calibration verdict for a geometry (largest measured
+    batch bucket wins — most representative of loaded traffic), or None
+    when nothing was measured yet."""
+    platform = jax.default_backend()
+    best = None
+    # snapshot: the warm-drainer thread inserts concurrently
+    for key, cal in list(_KERNEL_CALIBRATION.items()):
+        if key[:4] == (platform, n_pad, action_slots, placement_kernel):
+            if best is None or cal["sig"][2] > best["sig"][2]:
+                best = cal
+    return best["winner"] if best else None
 
 
 class _SlotAllocator:
@@ -229,7 +467,8 @@ class TpuBalancer(CommonLoadBalancer):
                  managed_fraction: float = 0.9, blackbox_fraction: float = 0.1,
                  batch_window: float = 0.002, max_batch: int = 256,
                  action_slots: int = 4096, max_action_slots: int = 65536,
-                 initial_pad: int = 64, mesh=None, kernel: str = "auto",
+                 initial_pad: int = 64, mesh=None,
+                 kernel: Optional[str] = None,
                  pipeline_depth: int = 4,
                  rate_limit_per_minute: Optional[int] = None,
                  placement_kernel: Optional[str] = None,
@@ -237,13 +476,18 @@ class TpuBalancer(CommonLoadBalancer):
                  ring_assembly: Optional[bool] = None,
                  prewarm: Optional[bool] = None,
                  adaptive_window: Optional[bool] = None,
+                 calibrate_kernel: Optional[str] = None,
                  profiler=None, anomaly=None, waterfall=None):
         super().__init__(messaging_provider, controller_instance, logger,
                          metrics, profiler=profiler, anomaly=anomaly,
                          waterfall=waterfall)
         self._cluster_size = cluster_size
-        self.kernel = kernel  # "auto" | "xla" | "pallas" (single-device)
         path_cfg = load_config(PlacementPathConfig, env_path="load_balancer")
+        #: "auto" | "xla" | "pallas" (single-device backend knob)
+        self.kernel = kernel if kernel is not None else path_cfg.kernel
+        if self.kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"kernel must be auto|xla|pallas, got {self.kernel!r}")
         #: scan | repair | auto — the batch algorithm on the XLA path
         self.placement_kernel = (placement_kernel if placement_kernel
                                  is not None else path_cfg.placement_kernel)
@@ -261,6 +505,19 @@ class TpuBalancer(CommonLoadBalancer):
                               else path_cfg.ring_assembly)
         self.prewarm = (prewarm if prewarm is not None
                         else path_cfg.prewarm)
+        self.calibrate_kernel = (calibrate_kernel if calibrate_kernel
+                                 is not None else path_cfg.calibrate_kernel)
+        if self.calibrate_kernel not in ("auto", "force", "off"):
+            raise ValueError(
+                f"calibrate_kernel must be auto|force|off, "
+                f"got {self.calibrate_kernel!r}")
+        #: how the running backend was picked: "explicit" (kernel knob),
+        #: "static" (resolve_auto_kernel guess), "calibration" (measured
+        #: rate), or "fallback" (pallas outgrew its VMEM budget)
+        self._kernel_chosen_by = ("explicit" if self.kernel != "auto"
+                                  else "static")
+        #: the latest calibration result applied/considered (admin/bench)
+        self._calibration: Optional[dict] = None
         self.adaptive_window = (adaptive_window if adaptive_window is not None
                                 else path_cfg.adaptive_window)
         #: publish inter-arrival EWMA (ms) — the adaptive window's pressure
@@ -385,6 +642,14 @@ class TpuBalancer(CommonLoadBalancer):
     def _resolve_kernel(self) -> str:
         if self.kernel != "auto":
             return self.kernel
+        # a cached MEASURED rate beats the static heuristic: a restarted
+        # balancer (or a promoted standby) with the same geometry adopts
+        # the calibration verdict immediately
+        cal = cached_backend_choice(self._n_pad, self.action_slots,
+                                    self.placement_kernel)
+        if cal is not None:
+            self._kernel_chosen_by = "calibration"
+            return cal
         return resolve_auto_kernel(self._n_pad, self.action_slots)
 
     def _init_device_state(self) -> None:
@@ -399,10 +664,7 @@ class TpuBalancer(CommonLoadBalancer):
         state = state._replace(health=health)
         self.kernel_resolved = (
             "sharded" if self.mesh is not None else self._resolve_kernel())
-        if self.placement_kernel == "repair" and self.mesh is None:
-            # explicit repair pins the XLA path: the pallas schedule has no
-            # repair loop (its VMEM-tiled scan IS its speedup)
-            self.kernel_resolved = "xla"
+        installed = False
         if self.mesh is not None:
             from ...parallel.sharded_state import (make_sharded_release,
                                                    make_sharded_schedule,
@@ -411,30 +673,20 @@ class TpuBalancer(CommonLoadBalancer):
             self._sched_fn = make_sharded_schedule(self.mesh)
             self._release_fn = make_sharded_release(self.mesh)
             self.placement_kernel_resolved = "scan"
+            installed = True
             if self.placement_kernel == "repair" and self.logger:
                 self.logger.warn(
                     None, "placement_kernel=repair has no sharded variant; "
                     "the mesh schedule keeps its scan kernel")
-        elif self.kernel_resolved == "pallas" and self._pallas_fits():
-            from ...ops.placement_pallas import (schedule_batch_pallas,
-                                                 to_transposed)
-            interpret = jax.default_backend() == "cpu"
-
-            @jax.jit
-            def sched(st, batch):
-                # kernel layout is conc-transposed; state everywhere else
-                # stays [N, A]. Converting inside jit keeps both transposes
-                # on-device in the same program as the kernel call.
-                ts, chosen, forced = schedule_batch_pallas(
-                    to_transposed(st), batch, interpret=interpret)
-                return (PlacementState(ts.free_mb, ts.conc_free.T,
-                                       ts.health), chosen, forced)
-
-            self.state = state
-            self._sched_fn = sched
-            self._release_fn = release_batch
-            self.placement_kernel_resolved = "scan"
-        else:
+        elif self.kernel_resolved == "pallas":
+            plan = self._pallas_plan()
+            if plan is not None:
+                self.state = state
+                pk = self.placement_kernel if plan == "repair" else "scan"
+                (self._sched_fn, self._release_fn,
+                 self.placement_kernel_resolved) = _pallas_pair(pk)
+                installed = True
+        if not installed and self.mesh is None:
             self.state = state
             self._sched_fn, self._release_fn = self._xla_fns()
             if self.kernel_resolved == "pallas":
@@ -445,70 +697,32 @@ class TpuBalancer(CommonLoadBalancer):
         # three dispatches per micro-batch), fed through the transfer-packed
         # wrappers (3 host->device transfers per step instead of 16)
         self._build_packed_fns()
+        self._export_kernel_gauge()
         self._set_books_now(np.asarray(self.state.free_mb))
 
-    #: batch-bucket width from which "auto" swaps the scan program for the
-    #: speculate-and-repair kernel. Below it the scan both EXECUTES fine
-    #: (a handful of sequential probe steps) and COMPILES ~3x faster
-    #: (~0.45 s vs ~1.2 s per bucket signature on a dev box) — and compile
-    #: latency is what light traffic actually feels, since a new bucket
-    #: shape jit-compiles inside a live dispatch. At and above it the
-    #: scan's B-length dependency chain dominates and repair wins outright.
-    REPAIR_MIN_BATCH = 32
-    #: on the CPU twin the repair program's per-round vector work (a full
-    #: [B, N] re-speculation plus [A]-wide conflict scatters) is real
-    #: compute, not free dispatch slack — below this fleet padding the
-    #: scan's short dependency chain is cheaper than one repair round
-    #: (measured ~4x at N=64, B<=64), so "auto" additionally requires
-    #: fleet >= this on CPU. Irrelevant on devices, where both programs
-    #: are dispatch-bound at these shapes.
-    REPAIR_MIN_FLEET_CPU = 256
+    #: class aliases of the module constants (tests and subclasses key off
+    #: these; the schedule-pair builders live at module level so the
+    #: calibration microbench can build pairs without a balancer)
+    REPAIR_MIN_BATCH = REPAIR_MIN_BATCH
+    REPAIR_MIN_FLEET_CPU = REPAIR_MIN_FLEET_CPU
 
     def _xla_fns(self):
-        """(schedule_fn, release_fn) for the XLA path, honoring the
-        placement-kernel knob. "repair" pins the speculate-and-repair
-        schedule + vectorized release fold at every size; "scan" keeps the
-        reference lax.scan pair (the true-no-op legacy path); "auto" picks
-        PER BUCKET — batch/release widths are static per jit signature, so
-        the branch resolves at trace time and each compiled program
-        contains exactly one kernel: scan below REPAIR_MIN_BATCH, repair
-        at and above it. All pairs are bit-exact (the fuzz suite asserts
-        it), so the knob only moves compile/run cost, never placements."""
-        if self.placement_kernel == "repair":
-            self.placement_kernel_resolved = "repair"
-            return schedule_batch_repair, release_batch_vector
-        if self.placement_kernel == "auto":
-            self.placement_kernel_resolved = "repair"
-            threshold = self.REPAIR_MIN_BATCH
-            min_fleet = (self.REPAIR_MIN_FLEET_CPU
-                         if jax.default_backend() == "cpu" else 0)
+        """(schedule_fn, release_fn) for the XLA backend — see
+        `_xla_pair`; this wrapper records the resolved algorithm."""
+        sched, release, resolved = _xla_pair(self.placement_kernel)
+        self.placement_kernel_resolved = resolved
+        return sched, release
 
-            def auto_schedule(state, batch):
-                # both shapes are static at trace time
-                if (batch.valid.shape[0] >= threshold
-                        and state.free_mb.shape[0] >= min_fleet):
-                    return schedule_batch_repair(state, batch)
-                return schedule_batch(state, batch)
-
-            def auto_release(state, inv, slot, need_mb, max_conc, valid):
-                if (inv.shape[0] >= threshold
-                        and state.free_mb.shape[0] >= min_fleet):
-                    return release_batch_vector(state, inv, slot, need_mb,
-                                                max_conc, valid)
-                return release_batch(state, inv, slot, need_mb, max_conc,
-                                     valid)
-
-            auto_schedule._placement_hybrid = True
-            auto_release._placement_hybrid = True
-            return auto_schedule, auto_release
-        self.placement_kernel_resolved = "scan"
-        return schedule_batch, release_batch
-
-    def _build_packed_fns(self) -> None:
-        # the profiler interposes on every jitted entry point: compile
-        # events classify by first-call / expect-window / pow2-bucketed
-        # statics (the only shapes _bucket may produce) — anything else is
-        # shape churn and trips the recompile watchdog
+    def _make_packed_fns(self, sched_fn, release_fn):
+        """Build (packed_step, release_packed) for a schedule pair —
+        profiler-wrapped, donation per the current gate — WITHOUT
+        installing them, so the calibration path can compile a candidate
+        backend's fns on the drainer thread and hand the loop finished
+        programs. The profiler interposes on every jitted entry point:
+        compile events classify by first-call / expect-window / rebuild
+        window / pow2-bucketed statics (the only shapes _bucket may
+        produce) — anything else is shape churn and trips the recompile
+        watchdog."""
         from ...ops.profiler import pow2_statics
         # buffer donation: XLA reuses the state's buffers for the output, so
         # the [N, A] concurrency matrix stops round-tripping HBM every step.
@@ -523,10 +737,9 @@ class TpuBalancer(CommonLoadBalancer):
                         and (jax.default_backend() != "cpu"
                              or self._donate_pinned))
         if self.rate_limit_per_minute is not None:
-            self._packed_fn = self.profiler.wrap(
+            packed = self.profiler.wrap(
                 "fused_admit_step",
-                make_fused_admit_step_packed(self._release_fn,
-                                             self._sched_fn,
+                make_fused_admit_step_packed(release_fn, sched_fn,
                                              donate=self._donate),
                 expected=pow2_statics)
             # bucket state is SOFT (a rolling rate window, never
@@ -537,15 +750,20 @@ class TpuBalancer(CommonLoadBalancer):
                 self._bucket_state = init_buckets(self.RATE_NS_BUCKETS,
                                                   self.rate_limit_per_minute)
         else:
-            self._packed_fn = self.profiler.wrap(
+            packed = self.profiler.wrap(
                 "fused_step",
-                make_fused_step_packed(self._release_fn, self._sched_fn,
+                make_fused_step_packed(release_fn, sched_fn,
                                        donate=self._donate),
                 expected=pow2_statics)
-        self._release_packed_fn = self.profiler.wrap(
+        release_packed = self.profiler.wrap(
             "release_packed",
-            make_release_packed(self._release_fn, donate=self._donate),
+            make_release_packed(release_fn, donate=self._donate),
             expected=lambda st, rel: _next_pow2(rel.shape[1]) == rel.shape[1])
+        return packed, release_packed
+
+    def _build_packed_fns(self) -> None:
+        self._packed_fn, self._release_packed_fn = self._make_packed_fns(
+            self._sched_fn, self._release_fn)
         # fn rebuild = fresh jit caches: everything needs re-warming (the
         # queue entries pin the fn they were enqueued for, so stale warms
         # drain harmlessly against the abandoned cache)
@@ -591,13 +809,21 @@ class TpuBalancer(CommonLoadBalancer):
         async def _drain():
             while self._warm_queue and not getattr(self, "_closing", False):
                 sig, fn = self._warm_queue.pop(0)
-                await asyncio.to_thread(self._warm_one, sig, fn)
+                decision = await asyncio.to_thread(self._warm_one, sig, fn)
+                if decision is not None:
+                    # calibration picked a different backend: the swap
+                    # applies HERE, back on the event loop, with fns that
+                    # compiled on the drainer thread — the loop never
+                    # compiles or calibrates
+                    self._apply_backend_decision(decision)
 
         self._warm_task = asyncio.get_event_loop().create_task(_drain())
         self._readbacks.add(self._warm_task)
         self._warm_task.add_done_callback(self._readbacks.discard)
 
-    def _warm_one(self, sig: tuple, fn) -> None:
+    def _warm_fns(self, sig: tuple, fn, release_packed_fn) -> None:
+        """Compile one (R, H, B) signature of a packed step + its
+        release-only program (drainer thread; XLA compiles drop the GIL)."""
         wr, wh, wb = sig
         rate_on = self.rate_limit_per_minute is not None
         rows = 10 if rate_on else 9
@@ -613,19 +839,25 @@ class TpuBalancer(CommonLoadBalancer):
                 jnp.zeros((self._n_pad, self.action_slots), jnp.int32),
                 jnp.zeros((self._n_pad,), bool))
 
+        if rate_on:
+            buckets = init_buckets(self.RATE_NS_BUCKETS,
+                                   self.rate_limit_per_minute)
+            fn((dummy_state(), buckets), buf,
+               np.float32(time.monotonic() - self._t0_mono), wr, wh, wb)
+        else:
+            fn(dummy_state(), buf, wr, wh, wb)
+        # the idle release fold compiles its own release-only program
+        # per R bucket — warm it too, or a drain-only lull still eats
+        # the in-dispatch compile stall this plane exists to avoid
+        release_packed_fn(dummy_state(), np.zeros((5, wr), np.int32))
+
+    def _warm_one(self, sig: tuple, fn) -> Optional[dict]:
+        """One warm-drainer unit of work (worker thread): compile the
+        signature, then — for kernel="auto" — run the one-shot calibration
+        microbench for it. Returns a backend-swap decision for the loop to
+        apply, or None."""
         try:
-            if rate_on:
-                buckets = init_buckets(self.RATE_NS_BUCKETS,
-                                       self.rate_limit_per_minute)
-                fn((dummy_state(), buckets), buf,
-                   np.float32(time.monotonic() - self._t0_mono), wr, wh, wb)
-            else:
-                fn(dummy_state(), buf, wr, wh, wb)
-            # the idle release fold compiles its own release-only program
-            # per R bucket — warm it too, or a drain-only lull still eats
-            # the in-dispatch compile stall this plane exists to avoid
-            self._release_packed_fn(dummy_state(),
-                                    np.zeros((5, wr), np.int32))
+            self._warm_fns(sig, fn, self._release_packed_fn)
         except Exception as e:  # noqa: BLE001 — warming is best-effort;
             # the live path compiles on demand anyway. But a SILENT fail
             # would make a systematically broken prewarm (dummy inputs
@@ -634,6 +866,123 @@ class TpuBalancer(CommonLoadBalancer):
             if self.logger:
                 self.logger.warn(None, f"bucket prewarm {sig} failed: {e!r}",
                                  "TpuBalancer")
+            return None
+        try:
+            return self._maybe_calibrate(sig)
+        except Exception as e:  # noqa: BLE001 — calibration is advisory:
+            # a failed microbench must never take the warm drainer down
+            if self.logger:
+                self.logger.warn(None, f"kernel calibration {sig} failed: "
+                                 f"{e!r}", "TpuBalancer")
+            return None
+
+    def _calibration_enabled(self) -> bool:
+        """Calibration requires an auto kernel knob, a single-device
+        balancer, and a backend where the pallas kernels actually compile
+        (a TPU) — unless "force" overrides for the CPU-twin tests/bench."""
+        if (self.kernel != "auto" or self.mesh is not None
+                or self.calibrate_kernel == "off"):
+            return False
+        if self.calibrate_kernel == "force":
+            return True
+        return jax.default_backend() == "tpu"
+
+    def _maybe_calibrate(self, sig: tuple) -> Optional[dict]:
+        """Drainer-thread half of the measured-rate auto policy: run (or
+        look up) the one-shot calibration for this bucket signature; when
+        the measured winner differs from the running backend, build AND
+        prewarm the winner's packed fns here so the loop-side swap
+        installs finished programs."""
+        if not self._calibration_enabled():
+            return None
+        from ...ops.placement_pallas import (HAS_PALLAS, fits_vmem,
+                                             fits_vmem_repair)
+        pallas_ok = HAS_PALLAS and (
+            fits_vmem_repair(self._n_pad, self.action_slots, self.max_batch)
+            if self.placement_kernel != "scan"
+            else fits_vmem(self._n_pad, self.action_slots))
+        if not pallas_ok:
+            # one-sided measurement cannot pick a winner: an xla-only
+            # bench would "win" by default and demote a statically-chosen
+            # (and unmeasured) pallas scan. The fit-based choice stands.
+            return None
+        r, h, b = sig
+        cal = calibrate_backend_rates(
+            self._n_pad, self.action_slots, r, h, b,
+            placement_kernel=self.placement_kernel,
+            iters=2 if self.calibrate_kernel == "force" else 5)
+        self._calibration = cal
+        # the SWAP decision follows the largest measured bucket for this
+        # geometry (cached_backend_choice — the same rule a restarted
+        # balancer applies at construction), not this signature's own row:
+        # a small bucket's noise verdict must not ping-pong the backend,
+        # since every swap flushes the warm jit caches
+        winner = (cached_backend_choice(self._n_pad, self.action_slots,
+                                        self.placement_kernel)
+                  or cal["winner"])
+        if winner == self.kernel_resolved:
+            self._kernel_chosen_by = "calibration"
+            self._export_kernel_gauge()
+            return None
+        pair = (_pallas_pair if winner == "pallas"
+                else _xla_pair)(self.placement_kernel)
+        packed, release_packed = self._make_packed_fns(pair[0], pair[1])
+        self._warm_fns(sig, packed, release_packed)
+        return {"kernel": winner, "pair": pair, "packed": packed,
+                "release_packed": release_packed, "sig": sig,
+                "n_pad": self._n_pad, "action_slots": self.action_slots,
+                "cal": cal}
+
+    def _apply_backend_decision(self, decision: dict) -> None:
+        """Event-loop half of the measured-rate auto policy: install a
+        calibration-chosen backend whose fns arrived compiled from the
+        drainer. Dropped when the world moved while calibration ran (fleet
+        growth re-keyed the geometry, the knobs changed, close() started).
+        The swap compiles nothing on the loop; the profiler's expect
+        window + rebuild-window classification keep the recompile watchdog
+        quiet through it."""
+        if (getattr(self, "_closing", False) or self.kernel != "auto"
+                or self.mesh is not None
+                or decision["n_pad"] != self._n_pad
+                or decision["action_slots"] != self.action_slots
+                or decision["kernel"] == self.kernel_resolved):
+            return
+        self.profiler.expect("kernel_swap")
+        sched, release, resolved = decision["pair"]
+        self.kernel_resolved = decision["kernel"]
+        self.placement_kernel_resolved = resolved
+        self._sched_fn, self._release_fn = sched, release
+        self._packed_fn = decision["packed"]
+        self._release_packed_fn = decision["release_packed"]
+        # fresh jit caches behind the installed fns: only the calibrated
+        # signature is warm; successor shapes re-enter the drainer as
+        # traffic hits them
+        self._warm_sigs = {decision["sig"]}
+        self._warm_queue = []
+        self._kernel_chosen_by = "calibration"
+        self._calibration = decision["cal"]
+        self._export_kernel_gauge()
+        if self.logger:
+            rates = decision["cal"]["rates"]
+            self.logger.info(
+                None, f"kernel calibration swapped the placement backend "
+                f"to {decision['kernel']} at sig={decision['sig']} "
+                f"(measured rates: {rates})", "TpuBalancer")
+
+    def _export_kernel_gauge(self) -> None:
+        """Info-style backend gauge: exactly one live
+        `loadbalancer_kernel_backend{backend,placement,chosen_by} 1`
+        series; the superseded combination is zeroed on swaps so a scrape
+        sees the flip, not two live backends."""
+        tags = {"backend": self.kernel_resolved,
+                "placement": getattr(self, "placement_kernel_resolved",
+                                     self.placement_kernel),
+                "chosen_by": getattr(self, "_kernel_chosen_by", "static")}
+        prev = getattr(self, "_kernel_gauge_tags", None)
+        if prev is not None and prev != tags:
+            self.metrics.gauge("loadbalancer_kernel_backend", 0, tags=prev)
+        self._kernel_gauge_tags = tags
+        self.metrics.gauge("loadbalancer_kernel_backend", 1, tags=tags)
 
     def _ns_slot(self, ns_id: str) -> int:
         slot = self._ns_slots.get(ns_id)
@@ -657,20 +1006,42 @@ class TpuBalancer(CommonLoadBalancer):
         the VMEM budget, via growth or snapshot restore)."""
         self.profiler.expect("kernel_swap")
         self.kernel_resolved = "xla"
+        self._kernel_chosen_by = "fallback"
         self._sched_fn, self._release_fn = self._xla_fns()
         self._build_packed_fns()
+        self._export_kernel_gauge()
 
-    def _pallas_fits(self) -> bool:
-        from ...ops.placement_pallas import fits_vmem
-        if fits_vmem(self._n_pad, self.action_slots):
-            return True
+    def _pallas_plan(self) -> Optional[str]:
+        """What the pallas backend can run at the current geometry:
+        "repair" (state + the repair kernel's residue scratch fit VMEM),
+        "scan" (only the resident state fits — placement_kernel="auto"
+        downgrades to the VMEM scan, which needs no [B, N] scratch), or
+        None (nothing fits, or pallas is unimportable). Explicit
+        placement_kernel="repair" never silently downgrades to the pallas
+        scan — it falls through to the XLA repair kernel instead. On None
+        the explicit-pallas fall-back-and-log contract applies: say why,
+        run XLA."""
+        from ...ops.placement_pallas import (PALLAS_IMPORT_ERROR, fits_vmem,
+                                             fits_vmem_repair)
+        repair_ok = (self.placement_kernel != "scan"
+                     and fits_vmem_repair(self._n_pad, self.action_slots,
+                                          self.max_batch))
+        if repair_ok:
+            return "repair"
+        scan_ok = (self.placement_kernel != "repair"
+                   and fits_vmem(self._n_pad, self.action_slots))
+        if scan_ok:
+            return "scan"
         if self.logger:
-            self.logger.warn(
-                None, f"pallas kernel needs VMEM-resident state; "
-                f"{self._n_pad}x{self.action_slots} does not fit — "
-                "using the XLA kernel")
+            why = (f"pallas unavailable: {PALLAS_IMPORT_ERROR}"
+                   if PALLAS_IMPORT_ERROR is not None else
+                   f"pallas kernel needs VMEM-resident state; "
+                   f"{self._n_pad}x{self.action_slots} "
+                   f"(placement_kernel={self.placement_kernel}, "
+                   f"max_batch={self.max_batch}) does not fit")
+            self.logger.warn(None, f"{why} — using the XLA kernel")
         self.kernel = "xla"
-        return False
+        return None
 
     def _slot_mb(self, user_memory_mb: int) -> int:
         return max(user_memory_mb // self._cluster_size, MIN_SLOT_MB)
@@ -842,9 +1213,21 @@ class TpuBalancer(CommonLoadBalancer):
             state = shard_state(state, self.mesh)
         self.state = state
         self._set_books_now(np.asarray(state.free_mb))
-        if (getattr(self, "kernel_resolved", self.kernel) == "pallas"
-                and not self._pallas_fits()):
-            self._use_xla_kernels()
+        if getattr(self, "kernel_resolved", self.kernel) == "pallas":
+            plan = self._pallas_plan()
+            if plan is None:
+                self._use_xla_kernels()
+            elif (plan == "scan"
+                  and getattr(self, "placement_kernel_resolved",
+                              "scan") == "repair"):
+                # growth kept the resident state inside the budget but
+                # evicted the repair kernel's residue scratch: downgrade
+                # to the VMEM scan in place
+                self.profiler.expect("kernel_swap")
+                (self._sched_fn, self._release_fn,
+                 self.placement_kernel_resolved) = _pallas_pair("scan")
+                self._build_packed_fns()
+                self._export_kernel_gauge()
 
     def _grow_slots(self, new_slots: int) -> None:
         """Widen conc_free's action axis, preserving every live permit."""
@@ -1116,7 +1499,13 @@ class TpuBalancer(CommonLoadBalancer):
         """The profiling-plane payload, labeled with the kernel actually
         running (xla / pallas / sharded) — host-side reads only, no device
         sync (memory_stats is a runtime counter read, not an array pull)."""
-        return self.profiler.profile_json(kernel=self.kernel_resolved)
+        out = self.profiler.profile_json(kernel=self.kernel_resolved)
+        out["placement_kernel"] = getattr(self, "placement_kernel_resolved",
+                                          self.placement_kernel)
+        out["kernel_chosen_by"] = getattr(self, "_kernel_chosen_by", "static")
+        if self._calibration is not None:
+            out["calibration"] = self._calibration
+        return out
 
     # -- placement journal (HA plane; loadbalancer/journal.py) -------------
     def attach_journal(self, journal) -> None:

@@ -52,7 +52,7 @@ invokers -> -1.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -168,6 +168,226 @@ def schedule_batch(state: PlacementState, batch: RequestBatch
     return new_state, chosen, forced
 
 
+class RepairPrims(NamedTuple):
+    """Index primitives the repair conflict rules are written against.
+
+    The RULES (`repair_commit_masks`) exist exactly once; only these five
+    order-sensitive reductions have backend-specific implementations:
+
+      `flat_prims`     — scatter/sort formulations over int32[B] vectors,
+                         O(B + key_size) per call: what `schedule_batch_repair`
+                         (the XLA kernel) uses.
+      `pairwise_prims` — [B, B] mask + reduction formulations over
+                         COLUMN-oriented int32[B, 1] vectors: no argsort, no
+                         scatter, no gather, no concatenate — the only shapes
+                         Mosaic (the Pallas TPU compiler) can lower. O(B^2),
+                         which at the balancer's B <= 256 is noise next to the
+                         [B, N] probe work.
+
+    Both must agree bit-for-bit (fuzz-asserted by
+    tests/test_placement_repair_pallas.py): a drift here is a drift between
+    the production kernels.
+
+      bidx                    request's own batch index (same orientation as
+                              the vectors the prims consume)
+      first_index_where(f, k, size)
+                              per request i: does any FLAGGED request j < i
+                              share my key?
+      any_same_key(f, k, size)
+                              per request i: does ANY flagged request (self
+                              included) share my key?
+      segment_exclusive_sum(v, k)
+                              per request i: sum of v[j] over j < i with
+                              k[j] == k[i]
+      exclusive_cumsum(v)     per request i: sum of v[j] over j < i
+      exclusive_cummax(v)     per request i: max of v[j] over j < i (0 when
+                              empty; callers pass non-negative values)
+      min_index_where(f)      smallest flagged batch index (B when none) —
+                              scalar-shaped for broadcasting against bidx
+    """
+    bidx: jax.Array
+    first_index_where: Callable
+    any_same_key: Callable
+    segment_exclusive_sum: Callable
+    exclusive_cumsum: Callable
+    exclusive_cummax: Callable
+    min_index_where: Callable
+
+
+def flat_prims(b: int) -> RepairPrims:
+    """Scatter/sort prims over flat int32[B] vectors (the XLA repair
+    kernel's implementations, unchanged from PR 5)."""
+    bidx = jnp.arange(b, dtype=jnp.int32)
+    sentinel = jnp.int32(b)
+
+    def first_index_where(flag, key, size):
+        # scatter-min of flagged indices onto the key axis, then gather —
+        # O(B + size) where the pairwise [B, B] formulation is O(B^2)
+        firsts = jnp.full((size,), sentinel).at[key].min(
+            jnp.where(flag, bidx, sentinel))
+        return firsts[key] < bidx
+
+    def any_same_key(flag, key, size):
+        return jnp.zeros((size,), bool).at[key].max(flag)[key]
+
+    def segment_exclusive_sum(values, key):
+        # stable sort by key keeps batch order inside each segment; a
+        # cummax of the segment-start prefix turns the global cumsum into
+        # per-segment exclusive sums
+        order = jnp.argsort(key, stable=True)
+        v_s = values[order]
+        k_s = key[order]
+        c = jnp.cumsum(v_s)
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), bool), k_s[1:] != k_s[:-1]])
+        base = jax.lax.cummax(jnp.where(seg_start, c - v_s, 0))
+        return jnp.zeros_like(c).at[order].set(c - v_s - base)
+
+    def exclusive_cumsum(values):
+        return jnp.cumsum(values) - values
+
+    def exclusive_cummax(values):
+        m = jax.lax.cummax(values)
+        return jnp.concatenate([jnp.zeros((1,), m.dtype), m[:-1]])
+
+    def min_index_where(flag):
+        return jnp.min(jnp.where(flag, bidx, sentinel))
+
+    return RepairPrims(bidx, first_index_where, any_same_key,
+                       segment_exclusive_sum, exclusive_cumsum,
+                       exclusive_cummax, min_index_where)
+
+
+def pairwise_prims(b: int) -> RepairPrims:
+    """Sort/scatter-free prims over COLUMN-oriented int32[B, 1] vectors
+    (self index on the sublane axis) — every helper is a [B, B] mask plus a
+    lane reduction, lowerable by Mosaic inside a Pallas kernel. The [1, B]
+    "other request" orientation is derived without a transpose op: mask the
+    [B, B] diagonal and reduce the sublane axis."""
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (b, b), 0)  # self
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (b, b), 1)  # other
+    eye = iota_s == iota_l
+    before = iota_l < iota_s  # other strictly earlier in batch order
+    bidx = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
+
+    def _row(col):
+        # [B, 1] -> [1, B] transpose via diagonal mask + sublane reduction
+        return jnp.sum(jnp.where(eye, col.astype(jnp.int32), 0), axis=0,
+                       keepdims=True)
+
+    def first_index_where(flag, key, size):
+        m = (_row(flag) > 0) & (_row(key) == key) & before
+        return jnp.any(m, axis=1, keepdims=True)
+
+    def any_same_key(flag, key, size):
+        m = (_row(flag) > 0) & (_row(key) == key)
+        return jnp.any(m, axis=1, keepdims=True)
+
+    def segment_exclusive_sum(values, key):
+        m = (_row(key) == key) & before
+        return jnp.sum(jnp.where(m, _row(values), 0), axis=1, keepdims=True)
+
+    def exclusive_cumsum(values):
+        return jnp.sum(jnp.where(before, _row(values), 0), axis=1,
+                       keepdims=True)
+
+    def exclusive_cummax(values):
+        return jnp.max(jnp.where(before, _row(values), 0), axis=1,
+                       keepdims=True)
+
+    def min_index_where(flag):
+        return jnp.min(jnp.where(flag, bidx, jnp.int32(b)))
+
+    return RepairPrims(bidx, first_index_where, any_same_key,
+                       segment_exclusive_sum, exclusive_cumsum,
+                       exclusive_cummax, min_index_where)
+
+
+def repair_commit_masks(prims: RepairPrims, *, pending, placed, forced, sel,
+                        take_mem, use_conc, simple, need_mb, conc_slot,
+                        free_at_sel, col_conc, n: int, a_slots: int,
+                        slot_ok=None):
+    """THE speculate-and-repair conflict rules — the one copy both the XLA
+    (`schedule_batch_repair`) and Pallas (`schedule_batch_repair_pallas`)
+    kernels execute per round, so the two implementations cannot drift.
+
+    Inputs are this round's speculation results (same orientation as
+    `prims.bidx`); returns `(safe, commit)` — the rows whose outcome is
+    settled this round and the subset that writes capacity. See
+    `schedule_batch_repair`'s docstring for the full exactness argument;
+    mechanically:
+
+      * `hard_conflict`: an earlier pending non-cascade writer shares my
+        chosen invoker, or an earlier container-opener shares my conc
+        column (its permit grant can flip my choice — or un-force me);
+      * `mem_conflict`: I take memory (non-forced) at an invoker whose
+        free space, after the committed cascade prefix's demand, no longer
+        covers my need;
+      * everything before the first conflict commits, plus outcome-
+        invariant rows (valid-but-unplaceable) and the provably
+        order-independent out-of-order commits (`ooo`): past the first
+        conflict, i may commit while earlier requests stay unresolved iff
+        every such straggler is a pure-memory request, a pessimistic
+        budget at sel_i covers all of them plus i, and i's conc write (if
+        any) touches no column a straggler probes.
+
+    `slot_ok` (None on the XLA path) marks requests whose conc_slot was in
+    range BEFORE clamping: the XLA scatters drop out-of-range keys while
+    gathers clamp them, and a caller that pre-clamps (the Pallas kernel,
+    whose `pl.ds` reads need in-range starts) passes the mask so the
+    slot-keyed writer flags reproduce exactly that drop-write/clamp-read
+    behavior."""
+    def _w(flag):
+        # writer-side validity for slot-keyed helpers (see slot_ok above)
+        return flag if slot_ok is None else flag & slot_ok
+
+    writer = pending & placed
+    # memory-cascade writers: touch only free_mb[sel], no conc cell
+    cascade = writer & take_mem & simple
+    hard = writer & ~cascade
+    grow = writer & take_mem & ~simple
+
+    hard_conflict = (prims.first_index_where(hard, sel, n)
+                     | prims.first_index_where(_w(grow), conc_slot, a_slots))
+    prior_mem = prims.segment_exclusive_sum(
+        jnp.where(cascade, need_mb, 0), sel).astype(jnp.int32)
+    mem_conflict = (take_mem & ~forced
+                    & (free_at_sel - prior_mem < need_mb))
+    conflict = pending & (hard_conflict | mem_conflict)
+    first_bad = prims.min_index_where(conflict)
+
+    # out-of-order commits past the first conflict (see docstring)
+    straggler = pending & placed & (prims.bidx >= first_bad)
+    grow_potential = prims.any_same_key(_w(pending & ~simple), conc_slot,
+                                        a_slots)
+    pure = simple & ~col_conc & ~grow_potential
+    bad_w = straggler & ~pure
+    impure_before = prims.exclusive_cumsum(bad_w.astype(jnp.int32)) > 0
+    s_demand = jnp.where(straggler, need_mb, 0)
+    demand_before = prims.exclusive_cumsum(s_demand).astype(jnp.int32)
+    # the budget must keep sel_i's eligibility bit STABLE for every
+    # earlier straggler too (they run before i sequentially, so their
+    # re-probe must not observe i's commit flipping has_mem at sel_i):
+    # reserve the largest earlier-straggler need on top of their total
+    # demand
+    max_need_before = prims.exclusive_cummax(s_demand).astype(jnp.int32)
+    budget_ok = (~take_mem |
+                 (free_at_sel - prior_mem - demand_before
+                  - max_need_before >= need_mb))
+    conc_write = use_conc | (take_mem & ~simple)
+    slot_probed_before = prims.first_index_where(_w(straggler), conc_slot,
+                                                 a_slots)
+    ooo = (pending & placed & ~forced & ~hard_conflict & ~impure_before
+           & budget_ok & ~(conc_write & slot_probed_before))
+
+    # prefix-closure: everything before the first conflict, plus rows
+    # whose outcome no commit can change (valid-but-unplaceable; the
+    # invalid rows never enter `pending`), plus the proven
+    # order-independent commits
+    safe = pending & ((prims.bidx < first_bad) | ~placed | ooo)
+    return safe, safe & placed
+
+
 def _probe_geometry(n: int, batch: RequestBatch):
     """The state-INDEPENDENT part of the batch probe, hoisted out of the
     repair loop: partition masks, probe ranks and the forced-placement
@@ -250,8 +470,7 @@ def schedule_batch_repair(state: PlacementState, batch: RequestBatch
     summary family.
     """
     b = batch.valid.shape[0]
-    bidx = jnp.arange(b, dtype=jnp.int32)
-    sentinel = jnp.int32(b)
+    prims = flat_prims(b)
 
     # loop-invariant geometry: ranks, partitions, and the whole forced
     # path (health is fixed inside a batch, and forced placement ignores
@@ -264,28 +483,6 @@ def schedule_batch_repair(state: PlacementState, batch: RequestBatch
     fchoice = jnp.argmin(fkey, axis=1).astype(jnp.int32)
     have_usable = jnp.take_along_axis(fkey, fchoice[:, None], 1)[:, 0] < big
     simple = batch.max_conc <= 1
-
-    def _first_index_where(flag, key, size):
-        """Per request i: does any FLAGGED request j < i share my `key`?
-        Scatter-min of flagged indices onto the key axis, then gather —
-        O(B + size) where the pairwise [B, B] formulation is O(B^2)."""
-        firsts = jnp.full((size,), sentinel).at[key].min(
-            jnp.where(flag, bidx, sentinel))
-        return firsts[key] < bidx
-
-    def _segment_exclusive_sum(values, key):
-        """Per request i: sum of `values[j]` over j < i with key_j ==
-        key_i. Stable sort by key keeps batch order inside each segment;
-        a cummax of the segment-start prefix turns the global cumsum into
-        per-segment exclusive sums."""
-        order = jnp.argsort(key, stable=True)
-        v_s = values[order]
-        k_s = key[order]
-        c = jnp.cumsum(v_s)
-        seg_start = jnp.concatenate(
-            [jnp.ones((1,), bool), k_s[1:] != k_s[:-1]])
-        base = jax.lax.cummax(jnp.where(seg_start, c - v_s, 0))
-        return jnp.zeros_like(c).at[order].set(c - v_s - base)
 
     def cond(carry):
         _, pending, _, _, rounds = carry
@@ -312,63 +509,18 @@ def schedule_batch_repair(state: PlacementState, batch: RequestBatch
         # any consumable permit on my column inside my partition? (feeds
         # the "pure memory request" predicate)
         col_conc = jnp.any(usable & has_conc, axis=1)
-        writer = pending & placed
-        # memory-cascade writers: touch only free_mb[sel], no conc cell
-        cascade = writer & take_mem & simple
-        hard = writer & ~cascade
-        grow = writer & take_mem & ~simple
-
-        hard_conflict = (_first_index_where(hard, sel, n)
-                         | _first_index_where(grow, batch.conc_slot,
-                                              a_slots))
-        prior_mem = _segment_exclusive_sum(
-            jnp.where(cascade, batch.need_mb, 0), sel).astype(jnp.int32)
         free_at_sel = state.free_mb[sel]
-        mem_conflict = (take_mem & ~forced
-                        & (free_at_sel - prior_mem < batch.need_mb))
-        conflict = pending & (hard_conflict | mem_conflict)
-        first_bad = jnp.min(jnp.where(conflict, bidx, jnp.int32(b)))
 
-        # out-of-order commits past the first conflict: i may commit while
-        # earlier requests stay unresolved iff every such straggler is a
-        # pure memory request, a pessimistic memory budget at sel_i covers
-        # all of them plus i, and i's conc write (if any) touches no column
-        # a straggler probes (see the docstring's order-independence
-        # argument). Conservative by construction: over-counting demand or
-        # purity only defers a commit to a later round, never mis-commits.
-        straggler = pending & placed & (bidx >= first_bad)
-        grow_potential = jnp.zeros((a_slots,), bool).at[batch.conc_slot].max(
-            pending & ~simple)[batch.conc_slot]
-        pure = simple & ~col_conc & ~grow_potential
-        bad_w = straggler & ~pure
-        impure_before = (jnp.cumsum(bad_w.astype(jnp.int32)) -
-                         bad_w.astype(jnp.int32)) > 0
-        s_demand = jnp.where(straggler, batch.need_mb, 0)
-        demand_before = (jnp.cumsum(s_demand) - s_demand).astype(jnp.int32)
-        # the budget must keep sel_i's eligibility bit STABLE for every
-        # earlier straggler too (they run before i sequentially, so their
-        # re-probe must not observe i's commit flipping has_mem at sel_i):
-        # reserve the largest earlier-straggler need on top of their total
-        # demand
-        max_need = jax.lax.cummax(s_demand)
-        max_need_before = jnp.concatenate(
-            [jnp.zeros((1,), max_need.dtype), max_need[:-1]]).astype(jnp.int32)
-        budget_ok = (~take_mem |
-                     (free_at_sel - prior_mem - demand_before
-                      - max_need_before >= batch.need_mb))
-        conc_write = use_conc | (take_mem & ~simple)
-        slot_probed_before = _first_index_where(straggler, batch.conc_slot,
-                                                a_slots)
-        ooo = (pending & placed & ~forced & ~hard_conflict & ~impure_before
-               & budget_ok & ~(conc_write & slot_probed_before))
-
-        # prefix-closure: everything before the first conflict, plus rows
-        # whose outcome no commit can change (valid-but-unplaceable; the
-        # invalid rows never enter `pending`), plus the proven
-        # order-independent commits
-        safe = pending & ((bidx < first_bad) | ~placed | ooo)
-
-        commit = safe & placed
+        # the conflict rules proper live in repair_commit_masks — ONE copy
+        # shared with the Pallas repair kernel. Conservative by
+        # construction: over-counting demand or purity only defers a
+        # commit to a later round, never mis-commits.
+        safe, commit = repair_commit_masks(
+            prims, pending=pending, placed=placed, forced=forced, sel=sel,
+            take_mem=take_mem, use_conc=use_conc, simple=simple,
+            need_mb=batch.need_mb, conc_slot=batch.conc_slot,
+            free_at_sel=free_at_sel, col_conc=col_conc,
+            n=n, a_slots=a_slots)
         dmem = jnp.where(commit & take_mem, batch.need_mb, 0)
         free_mb = state.free_mb.at[sel].add(-dmem.astype(jnp.int32))
         conc_delta = jnp.where(

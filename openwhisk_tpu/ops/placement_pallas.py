@@ -34,22 +34,115 @@ the step.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from .placement import PlacementState, RequestBatch, _mulmod
+from .placement import (PlacementState, RequestBatch, _mulmod,
+                        pairwise_prims, repair_commit_masks)
 
-# VMEM is ~16 MB/core; leave room for double-buffering and the runtime
-_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+# Import guard (CI satellite): environments whose jax predates
+# jax.experimental.pallas (or ships it broken) must not explode at import
+# time — the balancer probes `HAS_PALLAS` / `fits_vmem` (False) and keeps
+# the XLA path, and the pytest `pallas` marker skips with
+# `PALLAS_IMPORT_ERROR` as the logged reason.
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+    PALLAS_IMPORT_ERROR: Optional[str] = None
+except Exception as _e:  # noqa: BLE001 — any import failure means "no pallas"
+    pl = pltpu = None  # type: ignore[assignment]
+    HAS_PALLAS = False
+    PALLAS_IMPORT_ERROR = repr(_e)
+
+# VMEM fallback budget when the runtime reports no limit: cores ship
+# ~16 MB; leave room for double-buffering and the runtime
+_VMEM_FALLBACK_BYTES = 8 * 1024 * 1024
+_vmem_budget_cache: Optional[int] = None
+
+
+def vmem_budget_bytes() -> int:
+    """The VMEM byte budget `fits_vmem` checks against: the ACTUAL device
+    limit when the runtime reports one, else the conservative 8 MB
+    fallback. Probe order (cached after the first call):
+
+      1. `OPENWHISK_TPU_VMEM_BYTES` env override (operator escape hatch,
+         also what the regression tests pin);
+      2. a guarded `memory_stats()` / device-attribute probe — PJRT TPU
+         runtimes that expose a vmem size report it there;
+      3. the hard-coded fallback.
+
+    Whatever the source, half is held back for double-buffering and the
+    Mosaic runtime, matching the historical 8-of-16 split."""
+    global _vmem_budget_cache
+    if _vmem_budget_cache is not None:
+        return _vmem_budget_cache
+    budget = None
+    env = os.environ.get("OPENWHISK_TPU_VMEM_BYTES")
+    if env:
+        try:
+            budget = int(env) // 2
+        except ValueError:
+            budget = None
+    if budget is None:
+        try:
+            d = jax.local_devices()[0]
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — CPU/older PJRT: no stats
+                stats = {}
+            raw = next((int(v) for k, v in stats.items()
+                        if "vmem" in k and isinstance(v, int) and v > 0),
+                       None)
+            if raw is None:
+                attr = getattr(d, "vmem_size_bytes", None)
+                raw = int(attr) if isinstance(attr, int) and attr > 0 else None
+            if raw is not None:
+                budget = raw // 2
+        except Exception:  # noqa: BLE001 — introspection must never raise
+            budget = None
+    _vmem_budget_cache = budget if budget is not None else _VMEM_FALLBACK_BYTES
+    return _vmem_budget_cache
+
+
+def _reset_vmem_budget_cache() -> None:
+    """Test seam: re-probe the budget (env overrides are read once)."""
+    global _vmem_budget_cache
+    _vmem_budget_cache = None
 
 
 def fits_vmem(n_pad: int, action_slots: int) -> bool:
-    return (action_slots + 2) * n_pad * 4 <= _VMEM_BUDGET_BYTES
+    """Does the VMEM-resident scan kernel's state fit? (conc [A, N] + free/
+    health rows). Always False when pallas itself is unimportable."""
+    if not HAS_PALLAS:
+        return False
+    return (action_slots + 2) * n_pad * 4 <= vmem_budget_bytes()
+
+
+#: [B, N] buffers the repair kernel keeps live across the residue loop
+#: (probe-rank geometry + the gathered conc rows) plus the per-round
+#: materialized temporaries (Mosaic fuses the elementwise chains, so the
+#: eligibility/key/selection masks share, not stack), and the [B, B]
+#: pairwise conflict matrices
+_REPAIR_BN_BUFFERS = 4
+_REPAIR_BB_BUFFERS = 3
+
+
+def fits_vmem_repair(n_pad: int, action_slots: int, batch: int) -> bool:
+    """`fits_vmem` for the speculate-and-repair kernel: on top of the
+    resident state it budgets the residue loop's [B, N] scratch/temporaries
+    and the [B, B] pairwise conflict matrices (see repair kernel layout)."""
+    if not HAS_PALLAS:
+        return False
+    elems = ((action_slots + 2) * n_pad
+             + _REPAIR_BN_BUFFERS * batch * n_pad
+             + _REPAIR_BB_BUFFERS * batch * batch)
+    return elems * 4 <= vmem_budget_bytes()
 
 
 def to_transposed(state: PlacementState) -> PlacementState:
@@ -170,3 +263,203 @@ def schedule_batch_pallas(state: PlacementState, batch: RequestBatch,
 
     new_state = PlacementState(free_o.reshape(n), conc_o, state.health)
     return new_state, chosen.reshape(b), forced.reshape(b) > 0
+
+
+def _repair_kernel(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
+                   chosen_ref, forced_ref, rounds_ref, free_out, conc_out,
+                   conc_bn_ref):
+    """Speculate-and-repair in ONE kernel: full-batch probe, the shared
+    conflict rules (ops.placement.repair_commit_masks with the pairwise
+    prims), scatter-commit, and the residue loop — all with the fleet
+    state resident in VMEM, so repair rounds cost vector passes instead of
+    the multi-dispatch round trips the XLA while_loop pays per round.
+
+    Orientation: per-request vectors are COLUMNS ([B, 1], request on the
+    sublane axis) so [B, N] probe math and [B, B] pairwise conflict math
+    broadcast without transposes; the same request matrix arrives twice —
+    `reqs_ref` in SMEM (scalar reads for the dynamic-slice loops) and
+    `reqs_v_ref` in VMEM (column vectors for the batch math)."""
+    n = free_out.shape[1]
+    b = chosen_ref.shape[1]
+    big = jnp.int32(n + 2)
+    idx_bn = jax.lax.broadcasted_iota(jnp.int32, (b, n), 1)
+    bidx_col = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
+    eye_bb = (jax.lax.broadcasted_iota(jnp.int32, (b, b), 0)
+              == jax.lax.broadcasted_iota(jnp.int32, (b, b), 1))
+    prims = pairwise_prims(b)
+
+    # per-request columns [B, 1]
+    offset = reqs_v_ref[:, 0:1]
+    size = reqs_v_ref[:, 1:2]
+    home = reqs_v_ref[:, 2:3]
+    step_inv = reqs_v_ref[:, 3:4]
+    need = reqs_v_ref[:, 4:5]
+    slot_col = reqs_v_ref[:, 5:6]
+    maxc = reqs_v_ref[:, 6:7]
+    rand = reqs_v_ref[:, 7:8]
+    valid = reqs_v_ref[:, 8:9] > 0
+    slot_ok = reqs_v_ref[:, 9:10] > 0
+    simple = maxc <= 1
+
+    # state starts in the aliased output buffers
+    free_out[:] = free_ref[:]
+    conc_out[:] = conc_ref[:]
+
+    # loop-invariant geometry (health never changes inside a batch): probe
+    # ranks masked to the usable partition, and the whole forced path —
+    # forced placement ignores capacity, so fchoice/have_usable are fixed
+    local = idx_bn - offset
+    in_part = (local >= 0) & (local < size)
+    m = jnp.maximum(size, 1)
+    healthy = health_ref[:] > 0                      # [1, N]
+    usable = in_part & healthy
+    geom_key = jnp.where(usable, _mulmod(local - home, step_inv, m), big)
+    fkey = jnp.where(usable, jnp.mod(local - rand, m), big)
+    fmin = jnp.min(fkey, axis=1, keepdims=True)
+    fchoice = jnp.min(jnp.where(fkey == fmin, idx_bn, big), axis=1,
+                      keepdims=True)
+    have_usable = fmin < big
+    col_conc_geom = usable  # permit visibility is masked to the partition
+
+    def cond(carry):
+        pending, _, _, rounds = carry
+        return jnp.any(pending) & (rounds <= b)
+
+    def body(carry):
+        pending, chosen, forced_acc, rounds = carry
+        # per-round speculation: gather each request's conc column row
+        # (the only dynamically-indexed read; slots pre-clamped host-side)
+        def gather(i, _):
+            conc_bn_ref[pl.ds(i, 1), :] = conc_out[pl.ds(reqs_ref[i, 5], 1), :]
+            return 0
+
+        jax.lax.fori_loop(0, b, gather, 0)
+        conc_bn = conc_bn_ref[:]
+        has_conc = conc_bn > 0
+        free_row = free_out[:]                       # [1, N]
+        eligible = has_conc | (free_row >= need)
+        key = jnp.where(eligible, geom_key, big)
+        kmin = jnp.min(key, axis=1, keepdims=True)
+        choice = jnp.min(jnp.where(key == kmin, idx_bn, big), axis=1,
+                         keepdims=True)
+        found = kmin < big
+        sel = jnp.where(found, choice, fchoice)      # [B, 1]
+        placed = valid & (found | have_usable)
+        forced = valid & jnp.logical_not(found) & have_usable
+        is_sel = idx_bn == sel                       # [B, N]
+        conc_at_sel = jnp.sum(jnp.where(is_sel, conc_bn, 0), axis=1,
+                              keepdims=True)
+        use_conc = placed & (conc_at_sel > 0)
+        take_mem = placed & jnp.logical_not(use_conc)
+        col_conc = jnp.any(col_conc_geom & has_conc, axis=1, keepdims=True)
+        free_at_sel = jnp.sum(jnp.where(is_sel, free_row, 0), axis=1,
+                              keepdims=True)
+
+        safe, commit = repair_commit_masks(
+            prims, pending=pending, placed=placed, forced=forced, sel=sel,
+            take_mem=take_mem, use_conc=use_conc, simple=simple,
+            need_mb=need, conc_slot=slot_col, free_at_sel=free_at_sel,
+            col_conc=col_conc, n=n, a_slots=conc_out.shape[0],
+            slot_ok=slot_ok)
+
+        # commit: memory deltas collapse to one [B, N] -> [1, N] reduction
+        # (cascade writers on one invoker sum exactly); conc deltas are the
+        # rare class — scatter them row by row, predicated off for the
+        # (typical) zero-delta rows
+        dmem = jnp.sum(jnp.where(is_sel & commit & take_mem, need, 0),
+                       axis=0, keepdims=True)
+        free_out[:] = free_row - dmem.astype(jnp.int32)
+        conc_delta = jnp.where(
+            commit & use_conc, -1,
+            jnp.where(commit & take_mem & jnp.logical_not(simple),
+                      maxc - 1, 0))
+        # an out-of-range slot reads the clamped column but its write is
+        # DROPPED (XLA scatter semantics, like the scan kernel)
+        conc_delta = jnp.where(slot_ok, conc_delta, 0)
+
+        def put(i, _):
+            d = jnp.sum(jnp.where(bidx_col == i, conc_delta, 0))
+
+            @pl.when(d != 0)
+            def _():
+                sel_i = jnp.sum(jnp.where(bidx_col == i, sel, 0))
+                s = reqs_ref[i, 5]
+                row = conc_out[pl.ds(s, 1), :]
+                lane = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+                conc_out[pl.ds(s, 1), :] = row + jnp.where(
+                    lane == sel_i, d, 0).astype(jnp.int32)
+
+            return 0
+
+        jax.lax.fori_loop(0, b, put, 0)
+        chosen = jnp.where(safe, jnp.where(placed, sel, jnp.int32(-1)),
+                           chosen)
+        forced_acc = forced_acc | (safe & forced)
+        return (pending & jnp.logical_not(safe), chosen, forced_acc,
+                rounds + 1)
+
+    _, chosen, forced_acc, rounds = jax.lax.while_loop(
+        cond, body, (valid, jnp.full((b, 1), -1, jnp.int32),
+                     jnp.zeros((b, 1), bool), jnp.int32(0)))
+
+    # [B, 1] -> [1, B] result rows via the diagonal-mask transpose
+    chosen_ref[:] = jnp.sum(jnp.where(eye_bb, chosen, 0), axis=0,
+                            keepdims=True)
+    forced_ref[:] = jnp.sum(jnp.where(eye_bb, forced_acc.astype(jnp.int32),
+                                      0), axis=0, keepdims=True)
+    rounds_ref[0, 0] = rounds
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def schedule_batch_repair_pallas(state: PlacementState, batch: RequestBatch,
+                                 interpret: bool = False
+                                 ) -> Tuple[PlacementState, jax.Array,
+                                            jax.Array, jax.Array]:
+    """Drop-in for ops.placement.schedule_batch_repair (state in the
+    kernel's transposed [A, N] layout): same (state, chosen, forced,
+    rounds) contract, bit-exact with the XLA repair kernel — the conflict
+    rules are literally the same function (`repair_commit_masks`), only
+    the index primitives differ (pairwise vs scatter/sort; their
+    equivalence is fuzz-asserted). One pallas_call runs probe + conflict
+    detection + commit + the residue loop with the fleet books resident in
+    VMEM — no per-round dispatch round trips."""
+    n = state.free_mb.shape[0]
+    a = state.conc_free.shape[0]
+    b = batch.offset.shape[0]
+    # pl.ds needs an in-range start: clamp the gathered column (XLA's
+    # fancy-index gather does the same) and flag OOB slots so their writes
+    # — and their slot-keyed conflict marks — drop like XLA scatters
+    slot_ok = (batch.conc_slot >= 0) & (batch.conc_slot < a)
+    slot = jnp.clip(batch.conc_slot, 0, a - 1)
+    reqs = jnp.stack(
+        [batch.offset, batch.size, batch.home, batch.step_inv, batch.need_mb,
+         slot, batch.max_conc, batch.rand,
+         batch.valid.astype(jnp.int32), slot_ok.astype(jnp.int32)], axis=1)
+    free2 = state.free_mb.reshape(1, n)
+    health2 = state.health.astype(jnp.int32).reshape(1, n)
+
+    chosen, forced, rounds, free_o, conc_o = pl.pallas_call(
+        _repair_kernel,
+        out_shape=(jax.ShapeDtypeStruct((1, b), jnp.int32),
+                   jax.ShapeDtypeStruct((1, b), jnp.int32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((1, n), jnp.int32),
+                   jax.ShapeDtypeStruct((a, n), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((b, n), jnp.int32)],
+        input_output_aliases={3: 3, 4: 4},
+        interpret=interpret,
+    )(reqs, reqs, health2, free2, state.conc_free)
+
+    new_state = PlacementState(free_o.reshape(n), conc_o, state.health)
+    return (new_state, chosen.reshape(b), forced.reshape(b) > 0,
+            rounds.reshape(()))

@@ -202,8 +202,15 @@ class KernelProfiler:
             return fn
         entry = self._entries.get(name)
         if entry is None or entry["fn"] is not fn:
+            # re-registering a NAME with a new callable is a kernel/backend
+            # swap (pallas<->xla calibration, VMEM fallback, growth
+            # rebuild): stamp it so the fresh cache's compiles classify as
+            # the swap they are — for expect_window_s after the rebuild —
+            # instead of leaning on first_call (one compile only) or the
+            # shape predicate, and never as shape_churn
+            rebuilt = None if entry is None else time.monotonic()
             entry = {"fn": fn, "seen": {}, "compiles": 0,
-                     "expected": expected}
+                     "expected": expected, "rebuilt_at": rebuilt}
             self._entries[name] = entry
         seen = entry["seen"]
 
@@ -230,9 +237,15 @@ class KernelProfiler:
 
     def _on_compile(self, name: str, entry: dict, sig: tuple, args: tuple,
                     wall_ms: float) -> None:
+        rebuilt_at = entry.get("rebuilt_at")
         if self._expect_reason is not None \
                 and time.monotonic() < self._expect_until:
             exp, reason = True, self._expect_reason
+        elif rebuilt_at is not None and (time.monotonic() - rebuilt_at
+                                         < self.config.expect_window_s):
+            # a freshly swapped-in entry point recompiling its working set
+            # (see wrap): expected, whatever the signature looks like
+            exp, reason = True, "kernel_swap"
         elif entry["compiles"] == 0:
             exp, reason = True, "first_call"
         elif entry["expected"] is not None and entry["expected"](*args):

@@ -24,3 +24,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# `pallas` marker guard: environments whose jax lacks jax.experimental.pallas
+# (or where interpret mode is broken) must SKIP the pallas suites cleanly,
+# with a logged reason, instead of failing collection — tier-1 stays green
+# on the CPU twin either way.
+# ---------------------------------------------------------------------------
+_pallas_probe_result = None
+
+
+def _pallas_probe():
+    """(ok, reason) — cached; runs one trivial interpret-mode kernel so a
+    present-but-broken pallas is caught, not just a missing import."""
+    global _pallas_probe_result
+    if _pallas_probe_result is not None:
+        return _pallas_probe_result
+    try:
+        from openwhisk_tpu.ops import placement_pallas as pp
+        if not pp.HAS_PALLAS:
+            _pallas_probe_result = (
+                False, f"jax.experimental.pallas unavailable: "
+                       f"{pp.PALLAS_IMPORT_ERROR}")
+            return _pallas_probe_result
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def k(x_ref, o_ref):
+            o_ref[:] = x_ref[:] + 1
+
+        out = pl.pallas_call(
+            k, out_shape=jax.ShapeDtypeStruct((1, 8), jnp.int32),
+            interpret=True)(jnp.zeros((1, 8), jnp.int32))
+        assert int(out[0, 0]) == 1
+        _pallas_probe_result = (True, "")
+    except Exception as e:  # noqa: BLE001 — any breakage means "skip"
+        _pallas_probe_result = (False, f"pallas interpret mode broken: {e!r}")
+    return _pallas_probe_result
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    if not any("pallas" in item.keywords for item in items):
+        return
+    ok, reason = _pallas_probe()
+    if ok:
+        return
+    print(f"# skipping pallas-marked tests: {reason}", file=sys.stderr)
+    skip = pytest.mark.skip(reason=f"pallas unavailable: {reason}")
+    for item in items:
+        if "pallas" in item.keywords:
+            item.add_marker(skip)

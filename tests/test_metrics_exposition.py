@@ -265,6 +265,15 @@ class TestExpositionFormat:
             '{expected="true"}' in text
         assert types["openwhisk_loadbalancer_hbm_bytes_in_use"] == "gauge"
         assert types["openwhisk_loadbalancer_hbm_utilization_ratio"] == "gauge"
+        # the kernel-backend info gauge (ISSUE 10): one live series naming
+        # the running backend + placement algorithm + how they were chosen
+        assert types["openwhisk_loadbalancer_kernel_backend"] == "gauge"
+        backend_series = [ln for ln in text.splitlines() if ln.startswith(
+            "openwhisk_loadbalancer_kernel_backend{")]
+        assert backend_series
+        assert all('backend="' in ln and 'placement="' in ln
+                   and 'chosen_by="' in ln for ln in backend_series)
+        assert any(ln.endswith(" 1") for ln in backend_series)
         # the anomaly & alerting plane's families (ISSUE 4)
         assert types[
             "openwhisk_loadbalancer_invoker_anomaly_score"] == "gauge"

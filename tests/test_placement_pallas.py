@@ -10,6 +10,8 @@ import pytest
 
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.pallas
+
 from __graft_entry__ import _example_batch
 from openwhisk_tpu.ops.placement import init_state, schedule_batch, set_health
 from openwhisk_tpu.ops.placement_pallas import (fits_vmem,
