@@ -795,6 +795,131 @@ def _bus_coalesce_speedup(n_messages: int = 2048, wave: int = 64,
         return None
 
 
+def _host_obs_point(enabled: bool, rate: float, duration: float) -> dict:
+    """One fixed-rate open-loop measurement with the host hot-loop
+    observatory ON or OFF (run in a fresh CPU-pinned subprocess via
+    _cpu_subprocess_json: the observatory knobs are env-driven and its
+    planes are process-global, so each half must own its process). ON
+    attaches the observatory snapshot — loop lag, GC shares, serde shares,
+    self-time census — as `host`."""
+    import os
+    v = "true" if enabled else "false"
+    os.environ["CONFIG_whisk_hostProfiling_enabled"] = v
+    from tools.loadgen import sweep_balancer
+    row = sweep_balancer(fixed_rate=rate, duration=duration,
+                         host_observatory=enabled)
+    out = {
+        # CPU-twin by construction (CPU-pinned subprocess): say so, per
+        # the "never mistake a CPU number for a device number" rule
+        "backend": "cpu",
+        "offered_rate": rate,
+        "sustained": row.get("sustained"),
+        "activations_per_sec": row.get("sustained_activations_per_sec"),
+        "p50_ms": row.get("p50_ms"),
+        "p99_ms": row.get("p99_ms"),
+    }
+    if enabled:
+        out["host"] = row.get("host")
+    return out
+
+
+def _host_profiling_overhead(rate: float = 1024.0, duration: float = 2.5,
+                             repeats: int = 2) -> Optional[dict]:
+    """ISSUE 11 gate: ALL FOUR host-observatory planes (lag probe, gc
+    callbacks, task-factory interposer + serde accounting, sampler) must
+    cost <= 5% at the PR 7 open-loop sustained rate (~1000/s on the CPU
+    twin). Unlike the closed-loop plane riders, this one measures at the
+    open-loop saturation edge — where added per-activation host work shows
+    up as lost completions, not hidden queueing."""
+    try:
+        on_rates, off_rates = [], []
+        p99_on, p99_off = [], []
+        for _ in range(repeats):
+            on = _cpu_subprocess_json(
+                f"bench._host_obs_point(True, {rate}, {duration})",
+                "RIDERJSON", "host profiling on")
+            off = _cpu_subprocess_json(
+                f"bench._host_obs_point(False, {rate}, {duration})",
+                "RIDERJSON", "host profiling off")
+            if on and off and on.get("activations_per_sec") \
+                    and off.get("activations_per_sec"):
+                on_rates.append(on["activations_per_sec"])
+                off_rates.append(off["activations_per_sec"])
+                if on.get("p99_ms") is not None:
+                    p99_on.append(on["p99_ms"])
+                if off.get("p99_ms") is not None:
+                    p99_off.append(off["p99_ms"])
+        if not on_rates:
+            return None
+        on_med = statistics.median(on_rates)
+        off_med = statistics.median(off_rates)
+        return {
+            "rate_host_profiling_on": round(on_med, 1),
+            "rate_host_profiling_off": round(off_med, 1),
+            "overhead_pct": (round(100.0 * (off_med - on_med) / off_med, 2)
+                             if off_med else None),
+            # medians like the rates: one repeat's GC spike must not read
+            # as the observatory's latency cost
+            "p99_on_ms": statistics.median(p99_on) if p99_on else None,
+            "p99_off_ms": statistics.median(p99_off) if p99_off else None,
+            "offered_rate": rate,
+            "mode": "open_loop",
+            "repeats": len(on_rates),
+        }
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# host_profiling_overhead failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _host_observatory(rate: float = 1024.0, duration: float = 3.0
+                      ) -> Optional[dict]:
+    """ISSUE 11 payoff rider: the open-loop generator at the PR 7
+    sustained rate with the observatory ON — one JSON block with loop-lag
+    p50/p99, the GC pause share, per-hop serde shares, and the top-5
+    self-time frames. This is the measured target list ROADMAP item 1's
+    vectorization PR will be judged against: attack the component that
+    governs the p99, not the one that is easiest to vectorize."""
+    try:
+        point = _cpu_subprocess_json(
+            f"bench._host_obs_point(True, {rate}, {duration})",
+            "RIDERJSON", "host_observatory")
+        if point is None:
+            return None
+        host = point.get("host") or {}
+        lag = host.get("loop_lag") or {}
+        gc_block = host.get("gc") or {}
+        sampler = host.get("sampler") or {}
+        top = (sampler.get("top") or [])[:5]
+        serde_share = {
+            f"{row['hop']}/{row['direction']}": row["share_pct"]
+            for row in (host.get("serde") or [])}
+        return {
+            "backend": "cpu",
+            "offered_rate": rate,
+            "sustained": point.get("sustained"),
+            "sustained_activations_per_sec": point.get(
+                "activations_per_sec"),
+            "e2e_p99_ms": point.get("p99_ms"),
+            "loop_lag_p50_ms": lag.get("p50_ms"),
+            "loop_lag_p99_ms": lag.get("p99_ms"),
+            "loop_lag_max_ms": lag.get("max_ms"),
+            "gc_pause_share_pct": gc_block.get("pause_share_pct"),
+            "gc_pauses_in_dispatch": gc_block.get("overlapping_dispatch"),
+            "serde_share_pct": serde_share,
+            "top_self_time": top,
+            "distinct_hot_frames": len(sampler.get("top") or []),
+            "worst_stalls": (host.get("stalls") or {}).get("worst", [])[:5],
+            "tasks": host.get("tasks"),
+        }
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# host_observatory failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _bus_e2e_point(knobs_on: bool, rate: float, duration: float) -> dict:
     """One fixed-rate open-loop measurement for the bus_coalesce_speedup
     scoreboard (run in a fresh subprocess via _cpu_subprocess_json — the
@@ -1407,12 +1532,40 @@ def _ensure_backend(retries: int = 3, delay: float = 2.0,
             "error": last}
 
 
+def _host_info() -> dict:
+    """Box identity for the one-line JSON (ISSUE 11 satellite): BENCH_r0*
+    rounds land on a noisy shared machine — python/cpu/loadavg make rounds
+    comparable (a 4x loadavg delta explains a slow round better than any
+    code diff does)."""
+    import os
+    import platform
+    la = os.getloadavg()[0] if hasattr(os, "getloadavg") else None
+    return {
+        "python": platform.python_version(),
+        "cpu_count": os.cpu_count(),
+        "loadavg_1m_start": round(la, 2) if la is not None else None,
+    }
+
+
 def _run(args) -> Optional[dict]:
     import jax
 
     if args.sweep:
         _sweep()
         return None
+
+    host_info = _host_info()
+    rider_wall_s: dict = {}
+
+    def timed_rider(fn_name: str, fn) -> Optional[dict]:
+        """_run_rider + per-rider wall-time into the `host` block, so a
+        slow round names the stage that ate it."""
+        t0 = time.monotonic()
+        try:
+            return _run_rider(fn_name, fn)
+        finally:
+            rider_wall_s[fn_name.lstrip("_")] = round(
+                time.monotonic() - t0, 1)
 
     backend = _ensure_backend()
 
@@ -1434,6 +1587,8 @@ def _run(args) -> Optional[dict]:
 
     balancer = None
     balancer_host = None
+    host_profiling_overhead = None
+    host_observatory = None
     recorder_overhead = None
     telemetry_overhead = None
     profiling_overhead = None
@@ -1447,23 +1602,31 @@ def _run(args) -> Optional[dict]:
     if not args.quick:
         # the new headline first: the open-loop observatory (sustained
         # activations/s + the per-stage budget the next PR attacks)
-        e2e_open_loop = _run_rider("_e2e_open_loop", _e2e_open_loop)
-        bus_coalesce_speedup = _run_rider("_bus_coalesce_speedup",
-                                          _bus_coalesce_speedup)
-        failover_downtime = _run_rider("_failover_downtime",
-                                       _failover_downtime)
-        waterfall_overhead = _run_rider("_waterfall_overhead",
-                                        _waterfall_overhead)
-        repair_vs_scan = _run_rider("_repair_vs_scan", _repair_vs_scan)
-        pipeline_speedup = _run_rider("_pipeline_speedup", _pipeline_speedup)
-        recorder_overhead = _run_rider("_flight_recorder_overhead",
-                                       _flight_recorder_overhead)
-        telemetry_overhead = _run_rider("_telemetry_overhead",
-                                        _telemetry_overhead)
-        profiling_overhead = _run_rider("_profiling_overhead",
-                                        _profiling_overhead)
-        anomaly_overhead = _run_rider("_anomaly_overhead",
-                                      _anomaly_overhead)
+        e2e_open_loop = timed_rider("_e2e_open_loop", _e2e_open_loop)
+        # the host hot-loop observatory (ISSUE 11): its payoff block is
+        # the measured target list the 10k/s vectorization PR attacks,
+        # and its overhead gate keeps all four planes under the house 5%
+        host_observatory = timed_rider("_host_observatory",
+                                       _host_observatory)
+        host_profiling_overhead = timed_rider("_host_profiling_overhead",
+                                              _host_profiling_overhead)
+        bus_coalesce_speedup = timed_rider("_bus_coalesce_speedup",
+                                           _bus_coalesce_speedup)
+        failover_downtime = timed_rider("_failover_downtime",
+                                        _failover_downtime)
+        waterfall_overhead = timed_rider("_waterfall_overhead",
+                                         _waterfall_overhead)
+        repair_vs_scan = timed_rider("_repair_vs_scan", _repair_vs_scan)
+        pipeline_speedup = timed_rider("_pipeline_speedup",
+                                       _pipeline_speedup)
+        recorder_overhead = timed_rider("_flight_recorder_overhead",
+                                        _flight_recorder_overhead)
+        telemetry_overhead = timed_rider("_telemetry_overhead",
+                                         _telemetry_overhead)
+        profiling_overhead = timed_rider("_profiling_overhead",
+                                         _profiling_overhead)
+        anomaly_overhead = timed_rider("_anomaly_overhead",
+                                       _anomaly_overhead)
         rows = _balancer_rows()
         # c64 stays flattened at the top level (older readers); the rows
         # dict carries the per-concurrency detail + phase breakdowns
@@ -1556,6 +1719,10 @@ def _run(args) -> Optional[dict]:
         out["anomaly_overhead"] = anomaly_overhead
     if waterfall_overhead is not None:
         out["waterfall_overhead"] = waterfall_overhead
+    if host_profiling_overhead is not None:
+        out["host_profiling_overhead"] = host_profiling_overhead
+    if host_observatory is not None:
+        out["host_observatory"] = host_observatory
     if e2e_open_loop is not None:
         out["e2e_open_loop"] = e2e_open_loop
     if bus_coalesce_speedup is not None:
@@ -1571,13 +1738,23 @@ def _run(args) -> Optional[dict]:
                      profiling_overhead, anomaly_overhead,
                      waterfall_overhead, e2e_open_loop,
                      repair_vs_scan, pipeline_speedup,
-                     bus_coalesce_speedup, failover_downtime)):
+                     bus_coalesce_speedup, failover_downtime,
+                     host_profiling_overhead, host_observatory)):
         # a rider lost the device mid-run and re-ran on CPU: say so at the
         # top level so trajectory readers never mistake a CPU number for a
         # device number
         out["backend"] = "cpu_fallback"
     if multi:
         out["multi_controller"] = multi
+    # the `host` block (ISSUE 11 satellite): box identity + load brackets
+    # + per-rider wall-time, so BENCH rounds on the noisy box compare
+    la_end = None
+    import os as _os
+    if hasattr(_os, "getloadavg"):
+        la_end = round(_os.getloadavg()[0], 2)
+    host_info["loadavg_1m_end"] = la_end
+    host_info["rider_wall_s"] = rider_wall_s
+    out["host"] = host_info
     return out
 
 

@@ -124,6 +124,11 @@ class ControllerApi:
         # stats, plus the on-demand capture window (auth-gated)
         r.add_get("/admin/profile/kernel", self.profile_kernel)
         r.add_post("/admin/profile/capture", self.profile_capture)
+        # host hot-loop observatory: event-loop lag / GC pauses / task
+        # churn / serde shares / sampler self-time census, plus the
+        # bounded full-rate capture window (auth-gated, PR 3 pattern)
+        r.add_get("/admin/profile/host", self.profile_host)
+        r.add_post("/admin/profile/host/capture", self.profile_host_capture)
         # anomaly & alerting plane: active/recent alerts and per-invoker
         # anomaly scores with bucket-movement evidence (auth-gated)
         r.add_get("/admin/alerts", self.alerts_report)
@@ -467,6 +472,56 @@ class ControllerApi:
                           request.get("transid"))
         return web.json_response(prof.arm_capture(
             steps, trace_dir=trace_dir, tail_threshold_ms=ttl))
+
+    async def profile_host(self, request):
+        """The host hot-loop observatory snapshot (utils/hostprof.py):
+        event-loop lag percentiles (measured from each probe tick's
+        SCHEDULED deadline), the worst-stall ring, per-generation GC pause
+        accounting with the dispatch-overlap counter, task churn, per-hop
+        serde shares and the sampler's self-time top-N. Host-side reads
+        only — never a device sync, so it runs inline. `?collapsed=1`
+        adds the always-on census as flamegraph-format collapsed stacks
+        (the capture endpoint returns a full-rate bounded window
+        instead)."""
+        from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY as obs
+        snap = obs.snapshot()
+        if snap.get("enabled") and request.query.get(
+                "collapsed", "").lower() in ("1", "true", "yes"):
+            snap["collapsed"] = obs.collapsed_text()
+        return web.json_response(snap)
+
+    async def profile_host_capture(self, request):
+        """Arm a bounded full-rate host sampling window: `{"seconds": N}`
+        (capped at CONFIG_whisk_hostProfiling_captureLimitS) samples the
+        event-loop thread at CAPTURE_HZ and returns the window's self-time
+        top-N plus the collapsed (flamegraph-format) stacks. One window at
+        a time; 409 while host profiling is off or the sampler is down."""
+        from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY as obs
+        if not obs.enabled:
+            return _error(409, "host profiling is disabled "
+                          "(CONFIG_whisk_hostProfiling_enabled=false)",
+                          request.get("transid"))
+        if not obs.sampler_running:
+            return _error(409, "the host sampler is not running "
+                          "(observatory not installed or sampleHz=0)",
+                          request.get("transid"))
+        body = (await request.json()) if request.can_read_body else {}
+        if not isinstance(body, dict):
+            return _error(400, "capture request body must be a JSON object",
+                          request.get("transid"))
+        try:
+            seconds = float(body.get("seconds", 2.0))
+        except (TypeError, ValueError):
+            return _error(400, "seconds must be a number",
+                          request.get("transid"))
+        if seconds <= 0:
+            return _error(400, "seconds must be > 0", request.get("transid"))
+        try:
+            return web.json_response(await obs.capture(seconds))
+        except RuntimeError as e:
+            # a concurrent window is already armed (or the sampler died
+            # between the check above and the arm)
+            return _error(409, str(e), request.get("transid"))
 
     async def alerts_report(self, request):
         """The alert plane: configured rules, active (pending + firing)

@@ -126,6 +126,15 @@ class Controller:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 3233) -> None:
+        # host hot-loop observatory (utils/hostprof.py): event-loop lag,
+        # GC pauses, task churn/serde accounting and the sampling profiler
+        # arm on THIS controller's loop; the renderer joins this
+        # controller's /metrics page. install() is a refused no-op when
+        # CONFIG_whisk_hostProfiling_enabled=false or another controller
+        # in this process already owns the observatory.
+        from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY
+        self._host_observatory_owner = GLOBAL_HOST_OBSERVATORY.install(
+            metrics=self.metrics)
         self.cache_invalidation.start()
         if hasattr(self.load_balancer, "start"):
             await self.load_balancer.start()
@@ -157,6 +166,10 @@ class Controller:
                          "Controller")
 
     async def stop(self) -> None:
+        if getattr(self, "_host_observatory_owner", False):
+            from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY
+            GLOBAL_HOST_OBSERVATORY.uninstall()
+            self._host_observatory_owner = False
         if self._runner:
             await self._runner.cleanup()
         if self.membership is not None:

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Union
 
 from ...core.entity import (ActivationId, ExecutableWhiskAction, Identity,
                             InvokerInstanceId, WhiskAction, WhiskActivation)
-from ...messaging.connector import MessageFeed
+from ...messaging.connector import MessageFeed, decode_message
 from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
                                   parse_ack)
 from ...utils.logging import MetricEmitter
@@ -399,7 +399,11 @@ class CommonLoadBalancer(LoadBalancer):
 
     def process_acknowledgement(self, raw: bytes) -> None:
         try:
-            ack: AcknowledgementMessage = parse_ack(raw)
+            # decode_message: the ack parse is the completion fan-in's
+            # per-activation JSON cost — the host observatory counts its
+            # bytes + wall time under {hop="completion_ack",deserialize}
+            ack: AcknowledgementMessage = decode_message(
+                parse_ack, raw, "completion_ack")
         except (ValueError, KeyError) as e:
             if self.logger:
                 self.logger.error(TransactionId.LOADBALANCER,

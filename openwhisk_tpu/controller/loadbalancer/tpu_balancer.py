@@ -58,6 +58,7 @@ from ...utils.config import load_config
 from ...utils.ring_buffer import ColumnRing
 from ...messaging.coalesce import export_coalesce_gauges
 from ...messaging.tcp import export_bus_gauges
+from ...utils.hostprof import GLOBAL_HOST_OBSERVATORY
 from ...utils.tracing import export_tracing_gauges, trace_id_of
 from ...utils.waterfall import (STAGE_BATCH_ASSEMBLE, STAGE_DEVICE_DISPATCH,
                                 STAGE_DEVICE_READBACK, STAGE_PUBLISH_ENQUEUE)
@@ -2026,6 +2027,10 @@ class TpuBalancer(CommonLoadBalancer):
         buf = np.concatenate([rel_np.ravel(), health_np.ravel(),
                               req_np.ravel()])
         t_assembled = time.monotonic()
+        # host-observatory bracket: a GC pause landing inside this window
+        # stalls the device dispatch — counting it here turns a mysterious
+        # dispatch-stage outlier in the waterfall into an attributed cause
+        GLOBAL_HOST_OBSERVATORY.begin_dispatch()
         try:
             if rate_on:
                 (self.state, self._bucket_state), out = self._packed_fn(
@@ -2052,6 +2057,8 @@ class TpuBalancer(CommonLoadBalancer):
                 self.logger.error(None, f"device dispatch failed: {e!r}",
                                   "TpuBalancer")
             return
+        finally:
+            GLOBAL_HOST_OBSERVATORY.end_dispatch()
 
         # write-ahead journal: the state mutation above is committed on
         # the loop, so the record lands at exactly this point in mutation

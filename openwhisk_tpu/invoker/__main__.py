@@ -71,6 +71,13 @@ def main() -> None:
                 pool_config=ContainerPoolConfig(user_memory=MB(args.memory),
                                                 pause_grace=1.0),
                 logger=logger)
+            # host hot-loop observatory on the invoker's loop too: the
+            # pickup/ack path is half of the per-activation Python the
+            # 10k/s arc must attack. Installed BEFORE start() so the
+            # long-running feed/pinger tasks ride the stall interposer
+            # (off via CONFIG_whisk_hostProfiling_enabled=false).
+            from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY
+            GLOBAL_HOST_OBSERVATORY.install(metrics=logger.metrics)
             await invoker.start(start_prewarm=args.prewarm)
             if args.port:
                 server = InvokerServer(invoker, args.port)
@@ -79,6 +86,8 @@ def main() -> None:
                   f"bus {args.bus}, memory {args.memory}MB", flush=True)
             await wait_for_shutdown()
         finally:
+            from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY
+            GLOBAL_HOST_OBSERVATORY.uninstall()
             if server:
                 await server.stop()
             if invoker is not None:

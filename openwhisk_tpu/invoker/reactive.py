@@ -20,7 +20,8 @@ from ..core.entity import (ActivationResponse, EntityName, EntityPath,
                            ExecManifest, InvokerInstanceId, MemoryLimit,
                            WhiskActivation)
 from ..database import EntityStore, NoDocumentException
-from ..messaging.connector import MessageFeed, HEALTH_RETENTION_BYTES, HEALTH_TOPIC
+from ..messaging.connector import (MessageFeed, HEALTH_RETENTION_BYTES,
+                                   HEALTH_TOPIC, decode_message)
 from ..messaging.message import (ActivationMessage,
                                  CombinedCompletionAndResultMessage,
                                  CompletionMessage, PingMessage, ResultMessage)
@@ -148,7 +149,11 @@ class InvokerReactive:
                 feed.processed()
 
         try:
-            msg = ActivationMessage.parse(payload)
+            # decode_message: the per-activation JSON parse cost on the
+            # invoker loop, counted {hop="activation",deserialize} by the
+            # host observatory
+            msg = decode_message(ActivationMessage.parse, payload,
+                                 "activation")
         except (ValueError, KeyError) as e:
             if self.logger:
                 self.logger.error(TransactionId.SYSTEM,

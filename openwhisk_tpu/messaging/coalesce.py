@@ -35,7 +35,7 @@ from typing import Optional
 
 from ..utils.config import load_config
 from ..utils.microbatch import MicroCoalescer
-from .connector import MessageProducer
+from .connector import MessageProducer, encode_message
 
 #: process-wide coalescing health counters, exported as gauges by the
 #: balancers' supervision tick (export_coalesce_gauges) — one aggregate
@@ -85,8 +85,9 @@ class CoalescingProducer(MessageProducer):
     async def send(self, topic: str, msg) -> None:
         # serialize on the caller's turn: the flush loop then ships bytes
         # without touching message objects (and a slow .serialize() is
-        # charged to the sender, not to every batch-mate)
-        payload = msg if isinstance(msg, (bytes, bytearray)) else msg.serialize()
+        # charged to the sender, not to every batch-mate). encode_message
+        # also feeds the host observatory's per-hop serde accounting.
+        payload = encode_message(msg)
         await self._co.submit((topic, payload, msg))
 
     async def _ship(self, batch) -> None:

@@ -11,10 +11,60 @@ the bus instead of ballooning memory.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Awaitable, Callable, List, Optional, Tuple
 
+from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY
 from ..utils.transaction import TransactionId
 from ..utils.waterfall import GLOBAL_WATERFALL, STAGE_PRODUCE
+
+#: serde hop labels by message class (by NAME, so this module needs no
+#: import of messaging/message.py): the controller->invoker dispatch and
+#: the invoker->controller ack are the two hot hops; pings/events are the
+#: background chatter that should NOT hide inside them
+_SERDE_HOPS = {
+    "ActivationMessage": "activation",
+    "CompletionMessage": "completion_ack",
+    "ResultMessage": "completion_ack",
+    "CombinedCompletionAndResultMessage": "completion_ack",
+    "PingMessage": "health_ping",
+    "EventMessage": "event",
+}
+
+
+def hop_of(msg) -> str:
+    return _SERDE_HOPS.get(type(msg).__name__, "other")
+
+
+def encode_message(msg, hop: Optional[str] = None) -> bytes:
+    """Serialize a bus message with host-observatory serde accounting
+    (`openwhisk_host_serde_*_total{hop,direction="serialize"}`): the
+    byte+wall-time cost of every encode on the caller's turn becomes a
+    measured number instead of loop noise. Bytes pass through untouched;
+    with host profiling disabled this is a plain `msg.serialize()`."""
+    if isinstance(msg, (bytes, bytearray)):
+        return msg
+    obs = GLOBAL_HOST_OBSERVATORY
+    if not obs.serde_active:
+        return msg.serialize()
+    t0 = time.perf_counter_ns()
+    payload = msg.serialize()
+    obs.serde_observe(hop if hop is not None else hop_of(msg), "serialize",
+                      len(payload), time.perf_counter_ns() - t0)
+    return payload
+
+
+def decode_message(parse, raw, hop: str):
+    """`parse(raw)` with the matching deserialize-side accounting (the
+    invoker's ActivationMessage.parse, the balancer's ack parse)."""
+    obs = GLOBAL_HOST_OBSERVATORY
+    if not obs.serde_active:
+        return parse(raw)
+    t0 = time.perf_counter_ns()
+    msg = parse(raw)
+    obs.serde_observe(hop, "deserialize", len(raw),
+                      time.perf_counter_ns() - t0)
+    return msg
 
 
 def stamp_produce(msg) -> None:

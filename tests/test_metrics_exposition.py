@@ -151,7 +151,12 @@ class TestExpositionFormat:
         async def go():
             from openwhisk_tpu.controller.loadbalancer.journal import \
                 PlacementJournal
+            from openwhisk_tpu.utils.hostprof import GLOBAL_HOST_OBSERVATORY
             from openwhisk_tpu.utils.logging import NullLogging
+            # the host observatory's families (ISSUE 11) must render on
+            # the same page: Controller.start() installs it on this loop
+            GLOBAL_HOST_OBSERVATORY.enabled = True
+            GLOBAL_HOST_OBSERVATORY.reset()
             provider = MemoryMessagingProvider()
             # share one emitter between balancer and controller, the way
             # the production assemblies wire it (metrics=logger.metrics) —
@@ -220,6 +225,12 @@ class TestExpositionFormat:
                 # a value that needs label escaping must not corrupt a line
                 bal.metrics.counter("exposition_escape_probe",
                                     tags={"metric": 'a"b\\c\nd'})
+                # host observatory: force a GC pause so the per-generation
+                # family has a row (lag ticks + serde counters accumulated
+                # during the publishes above)
+                import gc as _gc
+                _gc.collect()
+                await asyncio.sleep(0.1)  # a few probe ticks post-collect
                 async with aiohttp.ClientSession() as s:
                     async with s.get(
                             f"http://127.0.0.1:{PORT}/metrics") as r:
@@ -310,6 +321,36 @@ class TestExpositionFormat:
             "openwhisk_activation_dominant_stage_total"] == "counter"
         assert 'openwhisk_activation_dominant_stage_total{scope="all"' \
             in text
+        # the host hot-loop observatory's families (ISSUE 11): loop lag
+        # as a REAL histogram, per-generation GC pauses, task churn, and
+        # the per-hop serde cost counters
+        assert types[
+            "openwhisk_host_event_loop_lag_seconds"] == "histogram"
+        assert 'openwhisk_host_event_loop_lag_seconds_bucket' \
+            '{le="1e-06",thread="event_loop"}' in text \
+            or 'openwhisk_host_event_loop_lag_seconds_bucket' \
+            '{thread="event_loop"' in text
+        assert types["openwhisk_host_gc_pause_seconds"] == "histogram"
+        gc_series = {dict(k[1]).get("generation")
+                     for k in out["histograms"]
+                     if k[0] == "openwhisk_host_gc_pause_seconds"}
+        assert gc_series, "no gc pause series rendered"
+        assert types["openwhisk_host_tasks_created_total"] == "counter"
+        assert types["openwhisk_host_tasks_finished_total"] == "counter"
+        assert types["openwhisk_host_tasks_active"] == "gauge"
+        assert types["openwhisk_host_loop_stalls_total"] == "counter"
+        assert types[
+            "openwhisk_host_gc_pauses_in_dispatch_total"] == "counter"
+        assert types["openwhisk_host_serde_seconds_total"] == "counter"
+        assert types["openwhisk_host_serde_bytes_total"] == "counter"
+        serde_lines = [ln for ln in text.splitlines() if ln.startswith(
+            "openwhisk_host_serde_seconds_total{")]
+        assert serde_lines and all(
+            'hop="' in ln and 'direction="' in ln for ln in serde_lines)
+        # the publish path serializes ActivationMessages (the coalescing
+        # producer's caller-turn encode) — that hop must be on the page
+        assert any('hop="activation"' in ln and 'direction="serialize"'
+                   in ln for ln in serde_lines)
 
 
 class TestOpenMetricsExemplars:

@@ -1,0 +1,202 @@
+"""Diff two BENCH_*.json rounds mechanically.
+
+ROADMAP house-keeping: the outstanding PR 9 claim (>5M placements/s for
+`pallas_repair`, a sane `auto_pick` verdict) needs a clean device round,
+and every round since r04 died on the dead-tunnel guard — when the next
+clean round lands, it should be judged by a tool, not by eyeballing two
+JSON blobs. This CLI prints a per-rider delta table between two rounds and
+exits nonzero when any HEADLINE metric regressed by more than the
+threshold (default 20%).
+
+Usage (documented in docs/tpu-balancer.md):
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r06.json
+    python tools/bench_compare.py old.json new.json --threshold 10
+
+Judgment rules:
+  * Only the curated HEADLINES list gates the exit code; the delta table
+    is informational and covers every shared numeric at the top two
+    levels.
+  * A metric missing (or null) on either side is SKIPPED and said so —
+    a rider that failed to run is a different problem than a regression.
+  * When the two rounds ran on different backends (`cpu_fallback`
+    tagging, unchanged from PR 4), the comparison is ADVISORY: deltas
+    print, the exit code stays 0, and the mismatch is named — a CPU
+    number must never fail a device round or vice versa.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Tuple
+
+#: (label, path into the round dict, direction). "higher" metrics regress
+#: when the new value drops below old*(1-thr); "lower" metrics (latencies,
+#: downtime) regress when the new value climbs above old*(1+thr).
+HEADLINES = (
+    ("placements_per_sec", ("value",), "higher"),
+    ("balancer_activations_per_sec",
+     ("balancer", "activations_per_sec"), "higher"),
+    ("e2e_sustained_per_sec",
+     ("e2e_open_loop", "sustained_activations_per_sec"), "higher"),
+    ("e2e_p99_ms", ("e2e_open_loop", "p99_ms"), "lower"),
+    ("host_observatory_sustained_per_sec",
+     ("host_observatory", "sustained_activations_per_sec"), "higher"),
+    ("host_observatory_loop_lag_p99_ms",
+     ("host_observatory", "loop_lag_p99_ms"), "lower"),
+    ("bus_coalesced_msgs_per_sec",
+     ("bus_coalesce_speedup", "coalesced_msgs_per_sec"), "higher"),
+    ("failover_downtime_ms", ("failover_downtime", "downtime_ms"), "lower"),
+)
+
+
+def unwrap_round(doc: dict) -> dict:
+    """Accept either a bare bench.py JSON line or the driver's
+    BENCH_r*.json envelope ({n, cmd, rc, tail}), whose `tail` holds the
+    process output with the one JSON line somewhere in it (usually last).
+    A dead round (rc!=0, no JSON line) unwraps to {} — every metric then
+    reads as missing, which is the honest verdict."""
+    if "value" in doc or "metric" in doc:
+        return doc
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    inner = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(inner, dict):
+                    return inner
+        return {}
+    return doc
+
+
+def _get(doc: dict, path: Tuple[str, ...]):
+    node = doc
+    for p in path:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(p)
+    return node if isinstance(node, (int, float)) and not isinstance(
+        node, bool) else None
+
+
+def _pct(old: float, new: float) -> Optional[float]:
+    if not old:
+        return None
+    return 100.0 * (new - old) / old
+
+
+def compare(old: dict, new: dict, threshold_pct: float = 20.0) -> dict:
+    """Headline verdicts + the informational delta table. Pure function:
+    the CLI below owns printing and the exit code."""
+    backend_old = old.get("backend") or (old.get("balancer") or {}).get(
+        "backend")
+    backend_new = new.get("backend") or (new.get("balancer") or {}).get(
+        "backend")
+    backend_mismatch = (backend_old is not None and backend_new is not None
+                        and backend_old != backend_new)
+    rows = []
+    regressions = []
+    for label, path, direction in HEADLINES:
+        o, n = _get(old, path), _get(new, path)
+        if o is None or n is None:
+            rows.append({"metric": label, "old": o, "new": n,
+                         "delta_pct": None, "verdict": "skipped (missing)"})
+            continue
+        delta = _pct(o, n)
+        regressed = False
+        if delta is not None:
+            if direction == "higher":
+                regressed = n < o * (1.0 - threshold_pct / 100.0)
+            else:
+                regressed = n > o * (1.0 + threshold_pct / 100.0)
+        verdict = "REGRESSED" if regressed else "ok"
+        if regressed and backend_mismatch:
+            verdict = "regressed (advisory: backend mismatch)"
+        elif regressed:
+            regressions.append(label)
+        rows.append({"metric": label, "old": o, "new": n,
+                     "delta_pct": round(delta, 1) if delta is not None
+                     else None, "verdict": verdict})
+
+    # informational table: every shared numeric at the top two levels
+    deltas = []
+
+    def walk(prefix, a, b, depth):
+        for k in sorted(set(a) & set(b)):
+            va, vb = a[k], b[k]
+            name = f"{prefix}{k}"
+            if isinstance(va, (int, float)) and not isinstance(va, bool) \
+                    and isinstance(vb, (int, float)) \
+                    and not isinstance(vb, bool):
+                deltas.append((name, va, vb, _pct(va, vb)))
+            elif isinstance(va, dict) and isinstance(vb, dict) and depth < 2:
+                walk(name + ".", va, vb, depth + 1)
+
+    walk("", old, new, 0)
+    return {
+        "headlines": rows,
+        "regressions": regressions,
+        "deltas": deltas,
+        "backend_old": backend_old,
+        "backend_new": backend_new,
+        "backend_mismatch": backend_mismatch,
+        "threshold_pct": threshold_pct,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("old", help="baseline BENCH_*.json")
+    ap.add_argument("new", help="candidate BENCH_*.json")
+    ap.add_argument("--threshold", type=float, default=20.0,
+                    help="regression threshold in percent (default 20)")
+    ap.add_argument("--full", action="store_true",
+                    help="print the full two-level delta table, not just "
+                         "the headline metrics")
+    args = ap.parse_args()
+    try:
+        with open(args.old) as f:
+            old = json.load(f)
+        with open(args.new) as f:
+            new = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_compare: cannot read rounds: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(old, dict) or not isinstance(new, dict):
+        print("bench_compare: rounds must be JSON objects", file=sys.stderr)
+        return 2
+    old, new = unwrap_round(old), unwrap_round(new)
+    out = compare(old, new, args.threshold)
+    if out["backend_mismatch"]:
+        print(f"# BACKEND MISMATCH: old={out['backend_old']} "
+              f"new={out['backend_new']} — comparison is advisory, "
+              "exit code stays 0")
+    w = max(len(r["metric"]) for r in out["headlines"])
+    print(f"{'metric':<{w}}  {'old':>12}  {'new':>12}  {'delta':>8}  verdict")
+    for r in out["headlines"]:
+        old_s = "-" if r["old"] is None else f"{r['old']:g}"
+        new_s = "-" if r["new"] is None else f"{r['new']:g}"
+        d = "-" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}%"
+        print(f"{r['metric']:<{w}}  {old_s:>12}  {new_s:>12}  {d:>8}  "
+              f"{r['verdict']}")
+    if args.full and out["deltas"]:
+        print("\n# full delta table (top two levels)")
+        for name, o, n, d in out["deltas"]:
+            ds = "-" if d is None else f"{d:+.1f}%"
+            print(f"{name}  {o:g} -> {n:g}  ({ds})")
+    if out["regressions"]:
+        print(f"\nREGRESSION: {', '.join(out['regressions'])} moved more "
+              f"than {args.threshold:g}% the wrong way", file=sys.stderr)
+        return 1
+    print(f"\nok: no headline metric regressed more than "
+          f"{args.threshold:g}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,12 +77,45 @@ async def open_loop(one: Callable[[int, int], Awaitable[bool]],
     scheduled offset regardless of earlier completions; `sched_ns`
     (time.monotonic_ns at the scheduled arrival) is the latency base —
     `one` returns True on success. Returns samples measured FROM the
-    schedule plus the generator's own health (fire lag)."""
+    schedule plus the generator's own health: fire lag, the generator
+    process's OWN GC pauses during the window, and an attribution of the
+    worst fire lag (gc_pause vs event_loop_stall) so a failed verdict can
+    blame the generator or the system instead of silently blaming the
+    balancer."""
+    import gc
+
     samples_ms: List[float] = []
     errors = 0
     fire_lag_max = 0.0
+    worst_lag_window = (0.0, 0.0)  # (sched, fire) monotonic seconds
     tasks: List[asyncio.Task] = []
     loop = asyncio.get_event_loop()
+
+    # generator self-check: GC pauses in THIS process during the window.
+    # A 100 ms collection between two scheduled fires reads exactly like a
+    # system stall in the fire-lag number — record the pauses so the
+    # verdict can tell them apart.
+    gc_stat = {"pauses": 0, "total_ms": 0.0, "max_ms": 0.0}
+    gc_recent: List[tuple] = []  # (start_mono, end_mono, dur_ms)
+    gc_t0 = {}
+
+    def _gc_cb(phase, info):
+        if phase == "start":
+            gc_t0["t"] = time.perf_counter()
+            return
+        t = gc_t0.pop("t", None)
+        if t is None:
+            return
+        dur_ms = (time.perf_counter() - t) * 1e3
+        gc_stat["pauses"] += 1
+        gc_stat["total_ms"] += dur_ms
+        gc_stat["max_ms"] = max(gc_stat["max_ms"], dur_ms)
+        end = time.monotonic()
+        gc_recent.append((end - dur_ms / 1e3, end, dur_ms))
+        if len(gc_recent) > 256:
+            del gc_recent[:128]
+
+    gc.callbacks.append(_gc_cb)
     t0 = time.monotonic()
     t0_ns = time.monotonic_ns()
 
@@ -97,21 +130,30 @@ async def open_loop(one: Callable[[int, int], Awaitable[bool]],
         else:
             errors += 1
 
-    i, n = 0, len(offsets)
-    while i < n:
-        now = time.monotonic() - t0
-        while i < n and offsets[i] <= now:
-            sched_ns = t0_ns + int(offsets[i] * 1e9)
-            # lateness of the FIRE vs the schedule: the generator's own
-            # health — a saturated event loop shows up here, and the
-            # latency sample already charges the lag to the system
-            fire_lag_max = max(fire_lag_max,
-                               (time.monotonic_ns() - sched_ns) / 1e6)
-            tasks.append(loop.create_task(timed(i, sched_ns)))
-            i += 1
-        if i < n:
-            await asyncio.sleep(offsets[i] - (time.monotonic() - t0))
-    fired_wall = time.monotonic() - t0
+    try:
+        i, n = 0, len(offsets)
+        while i < n:
+            now = time.monotonic() - t0
+            while i < n and offsets[i] <= now:
+                sched_ns = t0_ns + int(offsets[i] * 1e9)
+                # lateness of the FIRE vs the schedule: the generator's own
+                # health — a saturated event loop shows up here, and the
+                # latency sample already charges the lag to the system
+                lag = (time.monotonic_ns() - sched_ns) / 1e6
+                if lag > fire_lag_max:
+                    fire_lag_max = lag
+                    worst_lag_window = (t0 + offsets[i],
+                                        time.monotonic())
+                tasks.append(loop.create_task(timed(i, sched_ns)))
+                i += 1
+            if i < n:
+                await asyncio.sleep(offsets[i] - (time.monotonic() - t0))
+        fired_wall = time.monotonic() - t0
+    finally:
+        try:
+            gc.callbacks.remove(_gc_cb)
+        except ValueError:
+            pass
     done, pending = await asyncio.wait(tasks, timeout=drain_timeout) \
         if tasks else (set(), set())
     for p in pending:
@@ -127,11 +169,27 @@ async def open_loop(one: Callable[[int, int], Awaitable[bool]],
         return round(samples_ms[min(len(samples_ms) - 1,
                                     int(q * len(samples_ms)))], 3)
 
+    # attribute the WORST fire lag: a GC pause overlapping the
+    # [scheduled, fired] window makes the generator the culprit; otherwise
+    # something else held the loop (a system callback, the scheduler)
+    lag_cause = None
+    if fire_lag_max > 0.0:
+        w0, w1 = worst_lag_window
+        overlapped = any(s <= w1 and e >= w0 for s, e, _d in gc_recent)
+        lag_cause = "gc_pause" if overlapped else "event_loop_stall"
+
     return {
         "offered": n,
         "completed": len(samples_ms),
         "errors": errors,
         "unfinished": len(pending),
+        "generator": {
+            "gc_pauses": gc_stat["pauses"],
+            "gc_pause_total_ms": round(gc_stat["total_ms"], 3),
+            "gc_pause_max_ms": round(gc_stat["max_ms"], 3),
+            "max_fire_lag_ms": round(fire_lag_max, 3),
+            "max_fire_lag_cause": lag_cause,
+        },
         "wall_s": round(wall, 3),
         "fired_wall_s": round(fired_wall, 3),
         "throughput_per_sec": (round(len(samples_ms) / wall, 1)
@@ -146,18 +204,47 @@ async def open_loop(one: Callable[[int, int], Awaitable[bool]],
     }
 
 
+def verdict(row: dict, p99_bound_ms: float = DEFAULT_P99_BOUND_MS) -> dict:
+    """The sweep's step verdict with ATTRIBUTION: which checks failed, and
+    — when the generator fell behind its own schedule — whether the
+    generator's own GC (the open_loop self-check) or a loop stall caused
+    it. A rung failed by generator stalls is a harness problem; one failed
+    by p99/completions is the system's."""
+    failed: List[str] = []
+    total = row["completed"] + row["errors"] + row["unfinished"]
+    if not row["completed"]:
+        failed.append("no_completions")
+    else:
+        ratio = row["completed"] / max(1, total)
+        if ratio < MIN_COMPLETION_RATIO:
+            failed.append(f"completion_ratio {round(ratio, 3)} < "
+                          f"{MIN_COMPLETION_RATIO}")
+        if row["errors"] != 0:
+            failed.append(f"errors {row['errors']}")
+        if row["p99_ms"] is None or row["p99_ms"] > p99_bound_ms:
+            failed.append(f"p99 {row['p99_ms']}ms > {p99_bound_ms}ms")
+    if row["fire_lag_max_ms"] > MAX_FIRE_LAG_MS:
+        gen = row.get("generator") or {}
+        cause = gen.get("max_fire_lag_cause")
+        failed.append(
+            f"generator_fire_lag {row['fire_lag_max_ms']}ms"
+            + (f" (cause: {cause}, gc_pauses: {gen.get('gc_pauses')}, "
+               f"gc_max: {gen.get('gc_pause_max_ms')}ms)" if cause else ""))
+    out = {"sustainable": not failed, "failed": failed}
+    blame = "none"
+    if failed:
+        gen_only = all(f.startswith("generator_fire_lag") for f in failed)
+        blame = "generator" if gen_only else "system"
+    out["blames"] = blame
+    return out
+
+
 def sustainable(row: dict, p99_bound_ms: float = DEFAULT_P99_BOUND_MS) -> bool:
     """The sweep's step verdict: latency bounded, nothing lost, and the
     generator itself kept to its schedule (a lagging generator means the
-    offered rate was not actually offered)."""
-    if not row["completed"]:
-        return False
-    total = row["completed"] + row["errors"] + row["unfinished"]
-    return (row["completed"] / max(1, total) >= MIN_COMPLETION_RATIO
-            and row["errors"] == 0
-            and row["p99_ms"] is not None
-            and row["p99_ms"] <= p99_bound_ms
-            and row["fire_lag_max_ms"] <= MAX_FIRE_LAG_MS)
+    offered rate was not actually offered). `verdict()` is the explained
+    variant; this stays the boolean every older call site uses."""
+    return verdict(row, p99_bound_ms)["sustainable"]
 
 
 # -- the balancer target ---------------------------------------------------
@@ -264,14 +351,29 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                    p99_bound_ms: float = DEFAULT_P99_BOUND_MS,
                    dist: str = "poisson", n_invokers: int = 16,
                    kernel: str = "auto", waterfall: bool = True,
-                   fixed_rate: Optional[float] = None, seed: int = 1) -> dict:
+                   fixed_rate: Optional[float] = None, seed: int = 1,
+                   host_observatory: Optional[bool] = None) -> dict:
     """The observatory: sweep offered rate (doubling from `rate0`) to the
     max sustainable throughput, then re-measure that rate for the headline
     row + the waterfall's per-stage budget. `fixed_rate` skips the sweep
-    and measures one rate. Returns the `e2e_open_loop` block."""
+    and measures one rate. Returns the `e2e_open_loop` block.
+
+    `host_observatory`: True arms the host hot-loop observatory
+    (utils/hostprof.py) on the generator/balancer loop for the run and
+    attaches its snapshot as `host` — the bench riders' measured target
+    list; False forces it (and its always-on serde accounting) off for the
+    overhead rider's OFF half; None (default) leaves the process-global
+    state alone."""
 
     async def go() -> dict:
+        from openwhisk_tpu.utils.hostprof import GLOBAL_HOST_OBSERVATORY
         from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL
+        obs_installed = False
+        if host_observatory is not None:
+            GLOBAL_HOST_OBSERVATORY.enabled = bool(host_observatory)
+            if host_observatory:
+                GLOBAL_HOST_OBSERVATORY.reset()
+                obs_installed = GLOBAL_HOST_OBSERVATORY.install()
         target = _BalancerTarget(n_invokers=n_invokers, kernel=kernel,
                                  waterfall=waterfall)
         await target.start()
@@ -293,6 +395,11 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                     await _measure_step(target, rate, warm_t, dist,
                                         seed + 97 + p)
 
+            def judge(r: dict) -> bool:
+                r["verdict"] = verdict(r, p99_bound_ms)
+                r["sustainable"] = r["verdict"]["sustainable"]
+                return r["sustainable"]
+
             steps = []
             swept_ok = False
             if fixed_rate is not None:
@@ -306,7 +413,7 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                     await warm(rate)
                     row = await _measure_step(target, rate, duration, dist,
                                               seed)
-                    row["sustainable"] = sustainable(row, p99_bound_ms)
+                    judge(row)
                     if not row["sustainable"]:
                         # one retry: a first-sight bucket-shape compile is
                         # a ONE-TIME stall that reads exactly like
@@ -314,8 +421,7 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                         # saturation fails the retry too
                         retry = await _measure_step(target, rate, duration,
                                                     dist, seed + 31)
-                        retry["sustainable"] = sustainable(retry,
-                                                           p99_bound_ms)
+                        judge(retry)
                         retry["retried"] = True
                         if retry["sustainable"]:
                             row = retry
@@ -336,15 +442,20 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
             # per-stage budget are the headline (the sweep rows above only
             # bracketed it) — re-judged, so the top-level `sustained` flag
             # never launders an unsustainable rate into a headline
+            if obs_installed:
+                # scope the host observatory to the HEADLINE window:
+                # warmup's first-sight jit compiles would otherwise own
+                # the lag histogram and the self-time census
+                GLOBAL_HOST_OBSERVATORY.reset()
             head = await _measure_step(target, sustained_rate, duration,
                                        dist, seed + 1)
-            head["sustainable"] = sustainable(head, p99_bound_ms)
+            judge(head)
             if not head["sustainable"]:
                 # same one-retry rule as the sweep steps: a stray stall
                 # (GC, background compile) must not flip the headline
                 head = await _measure_step(target, sustained_rate, duration,
                                            dist, seed + 61)
-                head["sustainable"] = sustainable(head, p99_bound_ms)
+                judge(head)
                 head["retried"] = True
             # a borderline TOP rung that passed the sweep once but fails
             # its confirmation must not wipe the whole headline: fall back
@@ -356,12 +467,12 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                 sustained_rate /= 2
                 head = await _measure_step(target, sustained_rate, duration,
                                            dist, seed + fb_seed)
-                head["sustainable"] = sustainable(head, p99_bound_ms)
+                judge(head)
                 if not head["sustainable"]:
                     head = await _measure_step(target, sustained_rate,
                                                duration, dist,
                                                seed + fb_seed + 17)
-                    head["sustainable"] = sustainable(head, p99_bound_ms)
+                    judge(head)
                     head["retried"] = True
                 head["fell_back"] = True
                 fb_seed += 41
@@ -377,6 +488,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                 # the per-stage budget really explains the measured e2e
                 budget["budget_vs_measured_p50"] = round(
                     budget["p50_decomposition_sum_ms"] / head["p50_ms"], 3)
+            host = (GLOBAL_HOST_OBSERVATORY.snapshot() if obs_installed
+                    else None)
             return {
                 "mode": "open_loop",
                 "dist": dist,
@@ -392,10 +505,13 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                 "sweep": steps,
                 "stage_budget": budget,
                 "tail_attribution": tail,
+                "host": host,
                 "n_invokers": n_invokers,
             }
         finally:
             await target.stop()
+            if obs_installed:
+                GLOBAL_HOST_OBSERVATORY.uninstall()
 
     return asyncio.run(go())
 
@@ -415,13 +531,19 @@ def main() -> None:
     ap.add_argument("--invokers", type=int, default=16)
     ap.add_argument("--kernel", default="auto")
     ap.add_argument("--no-waterfall", action="store_true")
+    ap.add_argument("--host-observatory", action="store_true",
+                    help="arm the host hot-loop observatory "
+                         "(utils/hostprof.py) for the run and attach its "
+                         "snapshot as `host` in the JSON line")
     args = ap.parse_args()
     try:
         out = sweep_balancer(rate0=args.rate0, duration=args.duration,
                              p99_bound_ms=args.p99_bound_ms, dist=args.dist,
                              n_invokers=args.invokers, kernel=args.kernel,
                              waterfall=not args.no_waterfall,
-                             fixed_rate=args.rate)
+                             fixed_rate=args.rate,
+                             host_observatory=(True if args.host_observatory
+                                               else None))
     except Exception as e:  # noqa: BLE001 — one parseable line, always
         import traceback
         traceback.print_exc(file=sys.stderr)
