@@ -214,12 +214,18 @@ def _bench_action(name, memory=256):
 
 async def _echo_invoker(provider, instance):
     """An invoker stand-in: consumes its topic, acks every activation
-    immediately with a successful record (pure control-plane load)."""
+    immediately with a successful record (pure control-plane load). Rides
+    the same batch wire as the real InvokerReactive: a columnar dispatch
+    frame decodes ONCE, and the whole frame's acks are submitted in one
+    sweep so they coalesce into one ack batch frame back."""
     from openwhisk_tpu.core.entity import (ActivationResponse, EntityPath,
                                            WhiskActivation)
     from openwhisk_tpu.messaging import (ActivationMessage,
                                          CombinedCompletionAndResultMessage,
                                          MessageFeed)
+    from openwhisk_tpu.messaging.columnar import is_batch_payload
+    from openwhisk_tpu.messaging.connector import (decode_batch,
+                                                   decode_message)
 
     topic = instance.as_string
     provider.ensure_topic(topic)
@@ -231,15 +237,28 @@ async def _echo_invoker(provider, instance):
     box = {}
 
     async def handle(payload: bytes):
-        msg = ActivationMessage.parse(payload)
+        if is_batch_payload(payload):
+            _kind, msgs = decode_batch(payload)
+        else:
+            msgs = [decode_message(ActivationMessage.parse, payload,
+                                   "activation")]
         now = time.time()
-        act = WhiskActivation(
-            EntityPath(str(msg.user.namespace.name)), msg.action.name,
-            msg.user.subject, msg.activation_id, now, now,
-            ActivationResponse.success({"ok": True}), duration=1)
-        await producer.send(
-            f"completed{msg.root_controller_index.as_string}",
-            CombinedCompletionAndResultMessage(msg.transid, act, instance))
+        by_topic = {}
+        for msg in msgs:
+            act = WhiskActivation(
+                EntityPath(str(msg.user.namespace.name)), msg.action.name,
+                msg.user.subject, msg.activation_id, now, now,
+                ActivationResponse.success({"ok": True}), duration=1)
+            by_topic.setdefault(
+                f"completed{msg.root_controller_index.as_string}",
+                []).append(CombinedCompletionAndResultMessage(
+                    msg.transid, act, instance))
+        # send_batch: every ack submits in THIS sweep (one dispatch
+        # frame's acks flush as one ack batch frame) with no task per
+        # message — asyncio.gather over N send() coroutines minted a
+        # Task each, measurable loop churn at thousands of acks/s
+        for topic, acks in by_topic.items():
+            await producer.send_batch(topic, acks)
         box["feed"].processed()
 
     feed = MessageFeed(topic, consumer, 256, handle)
@@ -689,7 +708,7 @@ def _waterfall_overhead(**kw) -> Optional[dict]:
 
 
 def _e2e_open_loop_measure(rate0: float = 32.0, duration: float = 2.5,
-                           max_doublings: int = 7) -> Optional[dict]:
+                           max_doublings: int = 9) -> Optional[dict]:
     """The in-process body of the e2e_open_loop rider (run it in a fresh
     subprocess via _e2e_open_loop — see _subprocess_json for why)."""
     from tools.loadgen import sweep_balancer
@@ -697,8 +716,62 @@ def _e2e_open_loop_measure(rate0: float = 32.0, duration: float = 2.5,
                           max_doublings=max_doublings)
 
 
+def _latest_bench_round() -> Optional[tuple]:
+    """(filename, unwrapped round dict) of the newest BENCH_*.json beside
+    this script, or None. "Newest" is the name sort — the driver numbers
+    rounds r01, r02, ... monotonically."""
+    import glob
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not rounds:
+        return None
+    path = rounds[-1]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    from tools.bench_compare import unwrap_round
+    return os.path.basename(path), unwrap_round(doc)
+
+
+def _compared_to(rider_key: str, new_block: dict,
+                 latest: Optional[tuple] = None) -> Optional[dict]:
+    """The `compared_to` satellite (ISSUE 12): diff one rider's fresh
+    block against the same rider in the newest prior BENCH_*.json via
+    tools/bench_compare's headline rules. ADVISORY by contract — the
+    block reports regressions, it never fails the rider (the judgment
+    tool for a round stays the bench_compare CLI). `latest` lets a
+    caller that already loaded the baseline pass it in (one read, one
+    consistent baseline)."""
+    try:
+        if latest is None:
+            latest = _latest_bench_round()
+        if latest is None:
+            return None
+        fname, old_round = latest
+        old_block = old_round.get(rider_key)
+        if not isinstance(old_block, dict):
+            return {"baseline": fname, "skipped": f"no {rider_key} block "
+                    "in the baseline round"}
+        from tools.bench_compare import compare
+        out = compare({rider_key: old_block}, {rider_key: new_block})
+        headlines = [r for r in out["headlines"]
+                     if not r["verdict"].startswith("skipped")]
+        return {
+            "baseline": fname,
+            "advisory": True,
+            "headlines": headlines,
+            "regressions": out["regressions"],
+        }
+    except Exception as e:  # noqa: BLE001 — advisory must stay advisory
+        print(f"# compared_to({rider_key}) failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
-                   max_doublings: int = 7) -> Optional[dict]:
+                   max_doublings: int = 9) -> Optional[dict]:
     """The ISSUE 7 headline rider: open-loop offered-rate sweep against the
     live balancer path (tools/loadgen.py) — max sustainable activations/s
     with e2e p50/p99 measured from SCHEDULED arrival time (coordinated-
@@ -707,7 +780,9 @@ def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
     goes. Acceptance: the stage medians sum to ~the e2e median (no
     unaccounted gap) and the budget names the stage to attack next.
     Runs in a fresh backend-inheriting subprocess; falls back to a
-    CPU-pinned subprocess when the device is unavailable."""
+    CPU-pinned subprocess when the device is unavailable. The
+    `compared_to` block (ISSUE 12) diffs this run against the newest
+    prior BENCH_*.json round — advisory, never fails the rider."""
     expr = (f"bench._e2e_open_loop_measure({rate0}, {duration}, "
             f"{max_doublings})")
     out = _subprocess_json(expr, "RIDERJSON", "e2e_open_loop")
@@ -716,6 +791,10 @@ def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
                                    "e2e_open_loop cpu re-run")
         if out is not None:
             out["backend"] = "cpu_fallback"
+    if out is not None:
+        cmp_block = _compared_to("e2e_open_loop", out)
+        if cmp_block is not None:
+            out["compared_to"] = cmp_block
     return out
 
 
@@ -817,6 +896,7 @@ def _host_obs_point(enabled: bool, rate: float, duration: float) -> dict:
         "activations_per_sec": row.get("sustained_activations_per_sec"),
         "p50_ms": row.get("p50_ms"),
         "p99_ms": row.get("p99_ms"),
+        "completed": (row.get("headline") or {}).get("completed"),
     }
     if enabled:
         out["host"] = row.get("host")
@@ -873,14 +953,15 @@ def _host_profiling_overhead(rate: float = 1024.0, duration: float = 2.5,
         return None
 
 
-def _host_observatory(rate: float = 1024.0, duration: float = 3.0
+def _host_observatory(rate: float = 4096.0, duration: float = 3.0
                       ) -> Optional[dict]:
-    """ISSUE 11 payoff rider: the open-loop generator at the PR 7
-    sustained rate with the observatory ON — one JSON block with loop-lag
-    p50/p99, the GC pause share, per-hop serde shares, and the top-5
-    self-time frames. This is the measured target list ROADMAP item 1's
-    vectorization PR will be judged against: attack the component that
-    governs the p99, not the one that is easiest to vectorize."""
+    """ISSUE 11 payoff rider: the open-loop generator at the columnar
+    hot path's sustained offered rate (ISSUE 12: 4096 offered / ~3.3k
+    sustained on the 1-core twin, up from PR 7's 1024) with the
+    observatory ON — one JSON block with loop-lag p50/p99, the GC pause
+    share, per-hop serde shares, the top-5 self-time frames, and the
+    `stage_shares` table the ROADMAP "no dominant host stage" claim is
+    judged against (compared_to diffs the prior round's table in)."""
     try:
         point = _cpu_subprocess_json(
             f"bench._host_obs_point(True, {rate}, {duration})",
@@ -895,7 +976,27 @@ def _host_observatory(rate: float = 1024.0, duration: float = 3.0
         serde_share = {
             f"{row['hop']}/{row['direction']}": row["share_pct"]
             for row in (host.get("serde") or [])}
-        return {
+        tasks = host.get("tasks") or {}
+        completed = point.get("completed") or 0
+        # the ISSUE 12 stage-share table: the per-plane shares the
+        # "no dominant host stage" ROADMAP claim is judged against —
+        # recorded as a measured artifact next to the headline, with the
+        # prior round's table diffed in via compared_to below
+        worst_serde = max(serde_share.values(), default=0.0)
+        gc_share = gc_block.get("pause_share_pct") or 0.0
+        stage_shares = {
+            "serde_worst_hop_pct": worst_serde,
+            "serde_by_hop_pct": serde_share,
+            "gc_pause_pct": gc_share,
+            "loop_lag_p50_ms": lag.get("p50_ms"),
+            "loop_lag_p99_ms": lag.get("p99_ms"),
+            "tasks_per_activation": (round(tasks.get("created", 0)
+                                           / completed, 2)
+                                     if completed else None),
+            "no_plane_above_25pct": bool(worst_serde <= 25.0
+                                         and gc_share <= 25.0),
+        }
+        out = {
             "backend": "cpu",
             "offered_rate": rate,
             "sustained": point.get("sustained"),
@@ -908,11 +1009,28 @@ def _host_observatory(rate: float = 1024.0, duration: float = 3.0
             "gc_pause_share_pct": gc_block.get("pause_share_pct"),
             "gc_pauses_in_dispatch": gc_block.get("overlapping_dispatch"),
             "serde_share_pct": serde_share,
+            "stage_shares": stage_shares,
             "top_self_time": top,
             "distinct_hot_frames": len(sampler.get("top") or []),
             "worst_stalls": (host.get("stalls") or {}).get("worst", [])[:5],
             "tasks": host.get("tasks"),
         }
+        # before/after: the prior round's stage-share table beside this
+        # one (advisory, like the e2e compared_to) — ONE baseline read
+        # shared with the headline diff, so both halves describe the
+        # same round
+        latest = _latest_bench_round()
+        cmp_block = _compared_to("host_observatory", out, latest=latest)
+        if cmp_block is not None:
+            if latest is not None:
+                prior = (latest[1].get("host_observatory") or {})
+                cmp_block["before_stage_shares"] = (
+                    prior.get("stage_shares")
+                    or {"serde_by_hop_pct": prior.get("serde_share_pct"),
+                        "gc_pause_pct": prior.get("gc_pause_share_pct"),
+                        "loop_lag_p99_ms": prior.get("loop_lag_p99_ms")})
+            out["compared_to"] = cmp_block
+        return out
     except Exception as e:  # noqa: BLE001 — rider is auxiliary
         if _backend_unavailable(e):
             raise  # the fallback runner re-runs this rider on CPU
@@ -1682,8 +1800,18 @@ def _run(args) -> Optional[dict]:
           f"p50_step={headline['p50_step_ms']:.2f}ms "
           f"cpu_oracle={cpu_rate:.0f}/s parity={parity_ok}", file=sys.stderr)
 
+    # ALWAYS tag the round's backend: bench_compare's advisory
+    # backend-mismatch rule needs both sides tagged, and rounds before
+    # r06 only carried tags on fallback — an untagged device round
+    # diffed against a CPU round read as a 99% regression
+    try:
+        import jax
+        round_backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — a dead backend must not kill the line
+        round_backend = "unknown"
     out = {
         "metric": "placements_per_sec",
+        "backend": round_backend,
         "value": headline["rate_median"],
         "unit": "placements/s",
         "vs_baseline": round(headline["rate_median"] / TARGET, 3),

@@ -132,9 +132,19 @@ class Controller:
         # controller's /metrics page. install() is a refused no-op when
         # CONFIG_whisk_hostProfiling_enabled=false or another controller
         # in this process already owns the observatory.
-        from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY
+        from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY, tune_gc
         self._host_observatory_owner = GLOBAL_HOST_OBSERVATORY.install(
             metrics=self.metrics)
+        # opt-in GC tuning (CONFIG_whisk_host_gc_enabled): freeze the
+        # boot-time permanent heap out of the collector and raise the
+        # thresholds — full gen-2 scans were measured at 100-250 ms event
+        # loop stalls under load (utils/hostprof.py GcTuningConfig)
+        tuned = tune_gc()
+        if tuned is not None:
+            self.logger.info("controller",
+                             f"gc tuned: froze {tuned['frozen']} objects, "
+                             f"thresholds {tuned['thresholds']}",
+                             "Controller")
         self.cache_invalidation.start()
         if hasattr(self.load_balancer, "start"):
             await self.load_balancer.start()
@@ -176,6 +186,9 @@ class Controller:
             await self.membership.stop()  # sends the graceful leave
         for resource in self.owned_resources:
             await resource.stop()
+        if hasattr(self.entitlement, "close"):
+            # sharded front end: stop the admission worker loops
+            await self.entitlement.close()
         if self.load_balancer is not None:
             await self.load_balancer.close()
         await self.cache_invalidation.stop()

@@ -114,7 +114,7 @@ class LocalEntitlementProvider:
                  fires_per_minute: int = 60,
                  allowed_kinds: Optional[set] = None,
                  metrics=None, event_producer=None,
-                 admission_config=None):
+                 admission_config=None, frontend_config=None):
         self.load_balancer = load_balancer
         self.metrics = metrics
         self.event_producer = event_producer  # `events` topic (throttle events)
@@ -124,10 +124,17 @@ class LocalEntitlementProvider:
         # (CONFIG_whisk_admission_batch_enabled=false) keeps the serial
         # _check_throttles path bit-exact with the pre-batching behavior.
         from .admission import AdmissionBatchConfig, AdmissionPlane
+        from .frontend import FrontendConfig
         adm_cfg = (admission_config if admission_config is not None
                    else AdmissionBatchConfig.from_env())
+        fe_cfg = (frontend_config if frontend_config is not None
+                  else FrontendConfig.from_env())
+        # when the sharded front end will own admission (shards >= 2),
+        # the single-loop plane is never reachable from check() — don't
+        # build dead state whose stats would read 0 beside the real work
         self.admission: Optional[AdmissionPlane] = (
-            AdmissionPlane(self, adm_cfg) if adm_cfg.enabled else None)
+            AdmissionPlane(self, adm_cfg)
+            if adm_cfg.enabled and fe_cfg.shards <= 1 else None)
         cluster = max(1, getattr(load_balancer, "cluster_size", 1) or 1)
         per_instance = lambda n: max(1, int(n / cluster * self.OVERCOMMIT)) \
             if cluster > 1 else n
@@ -138,6 +145,23 @@ class LocalEntitlementProvider:
         self.concurrent = ActivationThrottler(load_balancer,
                                               per_instance(concurrent_invocations))
         self.allowed_kinds = allowed_kinds  # None = all kinds allowed
+        # sharded front end (controller/frontend.py): with
+        # CONFIG_whisk_frontend_shards >= 2, ACTIVATE throttle checks
+        # route to N admission worker loops partitioned by namespace
+        # hash, each owning its slice of throttle state (built LAST: the
+        # shard facades snapshot the throttler descriptions/limits
+        # above). None (shards=1, the default) keeps the single-loop
+        # admission path bit-exact. With admission BATCHING disabled the
+        # shards still own their namespace slices but flush one check at
+        # a time (max_batch=1) — a 1-deep rate_admit_batch is exactly the
+        # serial check, so the admission off-switch keeps its serial
+        # semantics under sharding instead of being silently bypassed.
+        from .frontend import maybe_shard_frontend
+        shard_adm = (adm_cfg if adm_cfg.enabled
+                     else AdmissionBatchConfig(enabled=False, window_ms=0.0,
+                                               max_batch=1))
+        self.frontend = maybe_shard_frontend(self, config=fe_cfg,
+                                             admission_config=shard_adm)
 
     # -- explicit grants (LocalEntitlement) --------------------------------
     def grant(self, subject: str, right: str, resource: str) -> None:
@@ -170,7 +194,13 @@ class LocalEntitlementProvider:
         if waterfall_ctx is not None:
             ActivationWaterfall.stamp_ctx(waterfall_ctx, STAGE_ENTITLE)
         if throttle and right == ACTIVATE:
-            if self.admission is not None:
+            if self.frontend is not None:
+                # sharded front end: the check runs on the worker loop
+                # owning this namespace's slice of admission state (same
+                # decisions, same exceptions — per-namespace arrival
+                # order is preserved by the hash partition)
+                await self.frontend.check_throttles(identity, is_trigger_fire)
+            elif self.admission is not None:
                 # batched path: this check coalesces with concurrent
                 # arrivals and resolves from one vectorized flush (same
                 # decisions, same exceptions as the serial path)
@@ -197,6 +227,15 @@ class LocalEntitlementProvider:
                     not self.concurrent.check(ns_id, limits.concurrent_invocations):
                 self._throttle_event("ConcurrentRateLimit", identity)
                 raise ThrottleRejectRequest(CONCURRENT_LIMIT_MESSAGE)
+
+    async def close(self) -> None:
+        """Stop the sharded front end's worker loops (no-op at shards=1).
+        The thread joins run on the executor — a slow shard must not
+        stall the controller loop mid-shutdown."""
+        if self.frontend is not None:
+            import asyncio
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.frontend.close)
 
     def check_kind(self, identity: Identity, kind: str) -> None:
         """Kind whitelist (ref KindRestrictor, Entitlement.scala:197-211)."""

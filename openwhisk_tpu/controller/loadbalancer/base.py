@@ -24,9 +24,11 @@ from typing import Dict, List, Optional, Union
 
 from ...core.entity import (ActivationId, ExecutableWhiskAction, Identity,
                             InvokerInstanceId, WhiskAction, WhiskActivation)
-from ...messaging.connector import MessageFeed, decode_message
+from ...messaging.connector import MessageFeed, decode_batch, decode_message
+from ...messaging.columnar import is_batch_payload
 from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
                                   parse_ack)
+from ...utils.config import load_config
 from ...utils.logging import MetricEmitter
 from ...utils.tracing import trace_id_of
 from ...utils.transaction import TransactionId
@@ -65,6 +67,19 @@ class InvokerHealth:
         if self.hint is not None:
             out["unhealthyHint"] = self.hint
         return out
+
+
+@dataclass(frozen=True)
+class BatchedAckConfig:
+    """`CONFIG_whisk_loadBalancer_batchedAck_*` env overrides: the
+    batch-shaped completion pipeline's off switch. Off = every ack in a
+    batch wire frame replays through the serial per-ack path —
+    bit-exact with processing N independent frames."""
+    enabled: bool = True
+
+    @classmethod
+    def from_env(cls) -> "BatchedAckConfig":
+        return load_config(cls, env_path="load_balancer.batched_ack")
 
 
 class LoadBalancerException(Exception):
@@ -184,6 +199,11 @@ class CommonLoadBalancer(LoadBalancer):
         # behavior: no stamp, always active.
         self.fence_epoch: Optional[int] = None
         self.ha_standby = False
+        #: batch-shaped completion pipeline (ISSUE 12): a batch wire ack
+        #: frame is processed in ONE pass (entries, telemetry, waterfall
+        #: folds) instead of N per-ack callback hops. False replays each
+        #: decoded ack through the serial path — bit-exact.
+        self.batched_ack = BatchedAckConfig.from_env().enabled
         self.activation_slots: Dict[str, ActivationEntry] = {}
         self.activations_per_namespace: Dict[str, int] = {}
         self._total = 0
@@ -388,7 +408,10 @@ class CommonLoadBalancer(LoadBalancer):
 
         async def handle(payload: bytes):
             try:
-                self.process_acknowledgement(payload)
+                if is_batch_payload(payload):
+                    self.process_acknowledgement_frame(payload)
+                else:
+                    self.process_acknowledgement(payload)
             finally:
                 feed_box["feed"].processed()
 
@@ -409,6 +432,10 @@ class CommonLoadBalancer(LoadBalancer):
                 self.logger.error(TransactionId.LOADBALANCER,
                                   f"corrupt completion ack: {e!r}")
             return
+        self._process_ack(ack)
+
+    def _process_ack(self, ack: AcknowledgementMessage) -> None:
+        """One decoded ack through the serial completion path."""
         if ack.activation is not None:
             self.process_result(ack.activation_id, ack.activation)
         if ack.is_slot_free:
@@ -416,6 +443,115 @@ class CommonLoadBalancer(LoadBalancer):
                                     forced=False,
                                     is_system_error=ack.is_system_error,
                                     invoker=ack.invoker)
+
+    def process_acknowledgement_frame(self, raw: bytes) -> None:
+        """A columnar ack batch frame off the completion feed: ONE decode
+        for the whole frame, then the batched one-pass completion path
+        (or, with `batched_ack` off, a serial replay of each ack —
+        bit-exact with N independent frames)."""
+        try:
+            _kind, acks = decode_batch(raw)
+        except (ValueError, KeyError, IndexError, TypeError,
+                AssertionError) as e:
+            if self.logger:
+                self.logger.error(TransactionId.LOADBALANCER,
+                                  f"corrupt completion ack batch: {e!r}")
+            return
+        if self.batched_ack:
+            self.process_acknowledgements(acks)
+        else:
+            for ack in acks:
+                try:
+                    self._process_ack(ack)
+                except Exception as e:  # noqa: BLE001 — per-ack isolation:
+                    # serial frames isolated failures per feed hand-off;
+                    # one ack's failure must not strand its frame-mates
+                    if self.logger:
+                        self.logger.error(TransactionId.LOADBALANCER,
+                                          f"ack processing failed: {e!r}")
+
+    def process_acknowledgements(self, acks: List[AcknowledgementMessage]
+                                 ) -> None:
+        """The batch-shaped completion pipeline (ISSUE 12): N acks in ONE
+        pass — results resolve first, then every slot release updates the
+        entry books directly, the completion_ack stamps share one clock,
+        the waterfall folds under one lock (finish_many), the regular-ack
+        counter increments once with the batch count, and the telemetry /
+        anomaly burn-gauge tick runs once per batch instead of per ack.
+        Decision-for-decision identical to process_completion; acks off
+        the wire are never `forced` (only the timeout timer forces)."""
+        wf = self.waterfall
+        now_ns = time.monotonic_ns() if wf.enabled else 0
+        now_mono = time.monotonic()
+        tp = self.telemetry
+        finish_aids: List[str] = []
+        regular = 0
+        for ack in acks:
+            try:
+                regular += self._process_ack_batched(
+                    ack, now_ns, now_mono, tp, wf, finish_aids)
+            except Exception as e:  # noqa: BLE001 — per-ack isolation (the
+                # serial frames isolated failures per feed hand-off)
+                if self.logger:
+                    self.logger.error(TransactionId.LOADBALANCER,
+                                      f"batched ack failed: {e!r}")
+        if regular:
+            self.metrics.counter("loadbalancer_completion_ack_regular",
+                                 regular)
+        if finish_aids:
+            wf.finish_many(finish_aids)
+        if tp.enabled:
+            tp.maybe_tick(self.metrics)
+            self.anomaly.maybe_tick(self.metrics)
+
+    def _process_ack_batched(self, ack, now_ns: int, now_mono: float,
+                             tp, wf, finish_aids: List[str]) -> int:
+        """One ack's share of the batched pass; returns 1 when it released
+        a tracked (regular) slot, 0 otherwise."""
+        if ack.activation is not None:
+            self.process_result(ack.activation_id, ack.activation)
+        if not ack.is_slot_free:
+            return 0
+        aid = ack.activation_id
+        entry = self.activation_slots.pop(aid.asString, None)
+        if entry is None:
+            # untracked ack: healthcheck or late-after-forced — the
+            # 4-way disambiguation, same counters as the serial path
+            if aid.asString in self._health_probe_ids:
+                self._health_probe_ids.discard(aid.asString)
+                self.metrics.counter(
+                    "loadbalancer_completion_ack_healthcheck")
+            else:
+                self.metrics.counter(
+                    "loadbalancer_completion_ack_regularAfterForced")
+            self.on_invocation_finished(
+                ack.invoker, is_system_error=ack.is_system_error,
+                forced=False)
+            return 0
+        if entry.timeout_task:
+            entry.timeout_task.cancel()
+        self._decr(entry)
+        if entry.invoker is not None:
+            self.release_invoker(entry.invoker, entry)
+        inv = ack.invoker or entry.invoker
+        # telemetry observe per completion, burn-gauge tick ONCE at the
+        # end of the pass (the serial path ticks per ack; tick() is
+        # 1 Hz-capped so the observable cadence is unchanged)
+        if tp.enabled and entry.t_start > 0.0 and inv is not None:
+            outcome = (OUTCOME_ERROR if ack.is_system_error
+                       else OUTCOME_SUCCESS)
+            tp.observe(inv.instance, entry.namespace_id,
+                       (now_mono - entry.t_start) * 1e3, outcome)
+        if wf.enabled:
+            if entry.stages is not None:
+                wf.stamp_ctx(entry.stages, STAGE_COMPLETION_ACK, now_ns)
+            else:
+                wf.stamp(aid.asString, STAGE_COMPLETION_ACK, now_ns)
+            finish_aids.append(aid.asString)
+        self.on_invocation_finished(inv,
+                                    is_system_error=ack.is_system_error,
+                                    forced=False)
+        return 1
 
     def process_result(self, aid: ActivationId, activation: WhiskActivation) -> None:
         """Complete the blocking client's promise (ref :235-243)."""

@@ -160,14 +160,19 @@ def resolve_auto_kernel(n_pad: int, action_slots: int) -> str:
 #: a new bucket shape jit-compiles inside a live dispatch. At and above it
 #: the scan's B-length dependency chain dominates and repair wins outright.
 REPAIR_MIN_BATCH = 32
-#: on the CPU twin the repair program's per-round vector work (a full
-#: [B, N] re-speculation plus [A]-wide conflict scatters) is real compute,
-#: not free dispatch slack — below this fleet padding the scan's short
-#: dependency chain is cheaper than one repair round (measured ~4x at
-#: N=64, B<=64), so XLA "auto" additionally requires fleet >= this on CPU.
-#: Irrelevant on devices, where both programs are dispatch-bound at these
-#: shapes.
-REPAIR_MIN_FLEET_CPU = 256
+#: extra CPU-twin fleet gate for "auto" — now 0 (no gate): the PR 5
+#: measurement that justified 256 ("scan beats repair ~4x at N=64,
+#: B<=64") predates PR 9's repair_commit_masks refactor and no longer
+#: reproduces — re-measured on the 1-core twin for ISSUE 12: at N_pad=64
+#: the scan's B-length sequential chain costs 0.6 ms (B=64) to 2.9 ms
+#: (B=256) per step while repair runs the same batches in 0.14-0.52 ms
+#: (rounds=1 on memory-dominant mixes, incl. same-action bursts of 32).
+#: At the batch-shaped hot path's B=256 buckets the scan chain was ~25%
+#: of the 1-core twin's wall. The convoy worst case (overflow chains
+#: serializing the repair loop) remains documented in the repair_vs_scan
+#: rider; REPAIR_MIN_BATCH still routes small batches to the scan for
+#: its 3x faster compiles.
+REPAIR_MIN_FLEET_CPU = 0
 
 
 def _xla_pair(placement_kernel: str):
@@ -1865,15 +1870,29 @@ class TpuBalancer(CommonLoadBalancer):
             b *= 2
         return min(b, cap) if n <= cap else cap
 
-    def _release_packed(self) -> np.ndarray:
+    def _release_packed(self, pad_to: Optional[int] = None) -> np.ndarray:
         """Drain buffered releases into ONE packed int32[5,R] host array
         (+ host-side slot bookkeeping) — same padding as _release_arrays.
         With ring_assembly the int columns were written at enqueue time, so
         assembly is two contiguous slice copies instead of a list-of-tuples
-        np.array transpose."""
-        cap = self.max_batch * 4
+        np.array transpose.
+
+        The per-step drain cap equals max_batch (not a multiple): the
+        batch-shaped ack path (ISSUE 12) lands a whole completion
+        frame's releases in one sweep, and larger caps reached R buckets
+        the steady state never compiles. The backlog still drains at >=
+        the ack arrival rate (releases match placements one-to-one), so
+        the leftover queue is bounded by one burst.
+
+        `pad_to`: the fused-step caller passes its shared (R, B) bucket
+        — see _dispatch_batch's shared-bucket rule — so the release axis
+        pads to the SAME power of two as the request axis instead of
+        minting an independent static dim."""
+        cap = self.max_batch
         rel, self._releases = self._releases[:cap], self._releases[cap:]
         b = self._bucket(len(rel), cap) if rel else 8
+        if pad_to is not None:
+            b = max(b, pad_to)
         out = np.zeros((5, b), np.int32)
         out[3, len(rel):] = 1  # padded rows: maxc=1
         if rel:
@@ -1977,7 +1996,19 @@ class TpuBalancer(CommonLoadBalancer):
             self._pending[self.max_batch:]
         t0 = time.monotonic()
         b = len(batch)
-        bp = self._bucket(b, self.max_batch)
+        # ONE shared power-of-two bucket for the release AND request axes:
+        # R and B are independent static dims of the fused program, so
+        # their cross product is the jit cache-key space — log2 x log2
+        # combos, most compiled mid-run the first time an arrival pattern
+        # surfaces them (the batch-shaped ack path made this chronic:
+        # measured as repeated ~400 ms first-sight compile stalls).
+        # Padding both axes to max(R_bucket, B_bucket) collapses the key
+        # space to log2(max_batch) shapes, which one warmup pass covers;
+        # the cost is a few masked zero rows in a kernel that is already
+        # shape-padded.
+        n_rel = min(len(self._releases), self.max_batch)
+        bp = max(self._bucket(b, self.max_batch),
+                 self._bucket(n_rel, self.max_batch) if n_rel else 8)
         # ONE packed request matrix: row layout must match
         # make_fused_step_packed (offset..rand, valid); request tuples are
         # already in row order, so one C-speed np.array call fills it.
@@ -2016,7 +2047,7 @@ class TpuBalancer(CommonLoadBalancer):
         # aid list is built once, only when the plane is live)
         wf = self.waterfall
         wf_aids = [e[4] for e in batch] if wf.enabled else None
-        rel_np = self._release_packed()
+        rel_np = self._release_packed(pad_to=bp)
         health_np = self._health_packed()
         # releases + health flips + schedule: ONE device program over ONE
         # host->device transfer and ONE packed result vector back (the old

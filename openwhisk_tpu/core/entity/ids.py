@@ -5,6 +5,7 @@ InstanceId.scala (common/scala/.../core/entity/).
 """
 from __future__ import annotations
 
+import os
 import re
 import secrets
 import uuid
@@ -27,10 +28,13 @@ class ActivationId:
 
     @classmethod
     def generate(cls) -> "ActivationId":
-        # uuid4().hex is 32 lowercase hex by construction — skip the
-        # parse-path normalization/validation on the publish hot path
+        # os.urandom(16).hex() is 32 lowercase hex by construction — the
+        # same 128 random bits as uuid4().hex at ~1/4 the cost (uuid4
+        # builds a UUID object, int-converts and re-formats; id minting
+        # is once per activation on the publish hot path and showed up
+        # in the host observatory's self-time census)
         aid = object.__new__(cls)
-        aid.asString = uuid.uuid4().hex
+        aid.asString = os.urandom(16).hex()
         return aid
 
     def to_json(self) -> str:

@@ -20,8 +20,10 @@ from ..core.entity import (ActivationResponse, EntityName, EntityPath,
                            ExecManifest, InvokerInstanceId, MemoryLimit,
                            WhiskActivation)
 from ..database import EntityStore, NoDocumentException
+from ..messaging.columnar import KIND_ACTIVATION, is_batch_payload
 from ..messaging.connector import (MessageFeed, HEALTH_RETENTION_BYTES,
-                                   HEALTH_TOPIC, decode_message)
+                                   HEALTH_TOPIC, decode_batch,
+                                   decode_message)
 from ..messaging.message import (ActivationMessage,
                                  CombinedCompletionAndResultMessage,
                                  CompletionMessage, PingMessage, ResultMessage)
@@ -139,7 +141,9 @@ class InvokerReactive:
         await self.factory.cleanup()
 
     # -- activation processing (ref :213-307) -------------------------------
-    async def _process(self, payload: bytes, feed: MessageFeed) -> None:
+    @staticmethod
+    def _make_release(feed: MessageFeed):
+        """One idempotent feed-capacity release per logical activation."""
         released = False
 
         def release():
@@ -148,6 +152,13 @@ class InvokerReactive:
                 released = True
                 feed.processed()
 
+        return release
+
+    async def _process(self, payload: bytes, feed: MessageFeed) -> None:
+        if is_batch_payload(payload):
+            await self._process_batch(payload, feed)
+            return
+        release = self._make_release(feed)
         try:
             # decode_message: the per-activation JSON parse cost on the
             # invoker loop, counted {hop="activation",deserialize} by the
@@ -160,6 +171,62 @@ class InvokerReactive:
                                   f"corrupt activation message: {e!r}", "InvokerReactive")
             release()
             return
+        GLOBAL_WATERFALL.stamp(msg.activation_id.asString,
+                               STAGE_INVOKER_PICKUP)
+        await self._handle_msg(msg, release)
+
+    async def _process_batch(self, payload: bytes, feed: MessageFeed) -> None:
+        """The batch-shaped pickup (ISSUE 12): one frame off the topic is
+        a whole dispatch micro-batch — ONE columnar decode (shared
+        identity/action parses), one waterfall stamp_many, one feed
+        capacity adjustment, then the per-activation body per message.
+        One frame = one handler task, so the per-activation task churn of
+        the serial pickup collapses into the batch."""
+        try:
+            kind, msgs = decode_batch(payload)
+            if kind != KIND_ACTIVATION:
+                raise ValueError(f"unexpected batch kind {kind!r} on the "
+                                 "activation topic")
+        except (ValueError, KeyError, IndexError, TypeError,
+                AssertionError) as e:
+            # IndexError/TypeError included: malformed batch COLUMNS (a
+            # dedup index past its table) parse as JSON but blow up in
+            # from_json — same corrupt-frame posture as a bad parse
+            if self.logger:
+                self.logger.error(TransactionId.SYSTEM,
+                                  f"corrupt activation batch: {e!r}",
+                                  "InvokerReactive")
+            feed.processed()
+            return
+        if not msgs:
+            # zero-row frame (no producer ships one, but a frame that
+            # decodes empty must still return its capacity unit)
+            feed.processed()
+            return
+        # the feed booked ONE capacity unit for this frame; a frame is N
+        # logical activations, each releasing independently
+        feed.consume_extra(len(msgs) - 1)
+        GLOBAL_WATERFALL.stamp_many(
+            [m.activation_id.asString for m in msgs], STAGE_INVOKER_PICKUP)
+        for msg in msgs:
+            release = self._make_release(feed)
+            try:
+                await self._handle_msg(msg, release)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — per-message isolation:
+                # in the serial path each payload ran in its own task, so
+                # one activation's failure never starved its batch-mates
+                # of processing or feed capacity
+                if self.logger:
+                    self.logger.error(TransactionId.SYSTEM,
+                                      f"batch activation failed: {e!r}",
+                                      "InvokerReactive")
+                release()
+
+    async def _handle_msg(self, msg: ActivationMessage, release) -> None:
+        """The per-activation body shared by the serial and batch pickup
+        paths (the pickup stage is already stamped by the caller)."""
         if msg.fence_epoch is not None:
             if msg.fence_epoch < self._max_fence_epoch:
                 # a superseded epoch's late batch: the current active (or
@@ -178,11 +245,10 @@ class InvokerReactive:
                 return
             self._max_fence_epoch = msg.fence_epoch
         from ..utils.tracing import GLOBAL_TRACER
-        # waterfall: the activation is off the bus and in the invoker's
-        # hands (single-process deployments share the controller's stage
-        # map; separate processes no-op on the unknown id)
-        GLOBAL_WATERFALL.stamp(msg.activation_id.asString,
-                               STAGE_INVOKER_PICKUP)
+        # (the waterfall invoker_pickup stamp happened at decode time —
+        # single frames stamp one id, batch frames stamp_many; in
+        # single-process deployments they share the controller's stage
+        # map, separate processes no-op on the unknown id)
         # stack-free span: concurrent activations may SHARE a transid (all
         # rules of one trigger fire), so the span is keyed by activation id
         # and parented straight from the message's trace context

@@ -40,7 +40,8 @@ from .connector import MessageProducer, encode_message
 #: process-wide coalescing health counters, exported as gauges by the
 #: balancers' supervision tick (export_coalesce_gauges) — one aggregate
 #: across producers, like the tracing gauges
-_STATS = {"batches": 0, "messages": 0, "max_batch": 0}
+_STATS = {"batches": 0, "messages": 0, "max_batch": 0,
+          "wire_batches": 0, "wire_batched_messages": 0}
 
 
 @dataclass(frozen=True)
@@ -56,6 +57,13 @@ class BusCoalesceConfig:
     #: stage p99 stays ~1 ms at the sustained rate). Set ~1 ms on expensive
     #: transports (remote TCP, Kafka) to also batch across waves.
     window_ms: float = 0.0
+    #: columnar batch wire (messaging/columnar.py): same-topic
+    #: activation/ack messages in one flush ship as ONE encoded batch
+    #: record — one json.dumps per batch with per-batch identity/action
+    #: dedup, instead of N independent encodes (and the encode moves to
+    #: flush time, so a message serialized for a batch is encoded exactly
+    #: once). False restores the serial wire format byte-exactly.
+    batch_wire: bool = True
 
     @classmethod
     def from_env(cls) -> "BusCoalesceConfig":
@@ -68,8 +76,9 @@ class CoalescingProducer(MessageProducer):
     (utils/microbatch.py) — the admission plane rides the same one."""
 
     def __init__(self, inner: MessageProducer, max_batch: int = 64,
-                 window_ms: float = 0.0):
+                 window_ms: float = 0.0, batch_wire: bool = False):
         self.inner = inner
+        self.batch_wire = batch_wire
         self._co = MicroCoalescer(self._ship, max_batch,
                                   max(0.0, float(window_ms)) / 1e3,
                                   name="bus-coalesce-drain")
@@ -83,21 +92,131 @@ class CoalescingProducer(MessageProducer):
         return self._co.pending_count
 
     async def send(self, topic: str, msg) -> None:
-        # serialize on the caller's turn: the flush loop then ships bytes
-        # without touching message objects (and a slow .serialize() is
-        # charged to the sender, not to every batch-mate). encode_message
-        # also feeds the host observatory's per-hop serde accounting.
+        # Batch wire fast path: a batchable message (activation / ack) is
+        # NOT encoded here — it rides to the flush as an object and is
+        # encoded exactly once, inside its batch's single json.dumps.
+        # Everything else serializes on the caller's turn as before (the
+        # flush loop then ships bytes without touching message objects,
+        # and a slow .serialize() is charged to the sender, not to every
+        # batch-mate). encode_message / encode_batch both feed the host
+        # observatory's per-hop serde accounting.
+        if self.batch_wire and not isinstance(msg, (bytes, bytearray)):
+            from .columnar import batchable_family
+            family = batchable_family(msg)
+            if family is not None:
+                await self._co.submit((topic, family, msg))
+                return
         payload = encode_message(msg)
         await self._co.submit((topic, payload, msg))
 
+    def _submit_nowait(self, topic: str, msg) -> "asyncio.Future":
+        """send() without the await: enqueue, return the flush future."""
+        if self.batch_wire and not isinstance(msg, (bytes, bytearray)):
+            from .columnar import batchable_family
+            family = batchable_family(msg)
+            if family is not None:
+                return self._co.submit_nowait((topic, family, msg))
+        return self._co.submit_nowait((topic, encode_message(msg), msg))
+
+    async def send_batch(self, topic: str, msgs: list) -> None:
+        """Submit a whole wave in one sweep and await the flush ONCE: the
+        per-item futures resolve together (same per-item error
+        propagation as N send() calls) with no task per message —
+        `asyncio.gather` over coroutines would mint one Task each, which
+        at thousands of acks/s was measurable loop churn. Failures
+        gather with return_exceptions so sibling futures are all
+        retrieved (no unretrieved-exception log spam), then the first
+        real failure raises."""
+        import asyncio
+        futs = [self._submit_nowait(topic, m) for m in msgs]
+        results = await asyncio.gather(*futs, return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+
     async def _ship(self, batch) -> None:
         """One coalesced flush: the whole batch rides the provider's
-        send_many (one pubN frame on the TCP bus). The coalescer resolves
-        the waiter futures on return / failure."""
+        send_many (one pubN frame on the TCP bus). With the batch wire
+        on, same-topic batchable messages collapse into ONE columnar
+        record per (topic, family) — encoded here, exactly once per
+        message — so the pubN frame carries one payload per topic
+        instead of one per message. The coalescer resolves the waiter
+        futures on return / failure."""
         _STATS["batches"] += 1
         _STATS["messages"] += len(batch)
         _STATS["max_batch"] = max(_STATS["max_batch"], len(batch))
-        await self.inner.send_many([item for (item, _fut) in batch])
+        if not self.batch_wire:
+            await self.inner.send_many([item for (item, _fut) in batch])
+            return
+        from .connector import encode_batch
+        # group deferred-encode messages per (topic, family), preserving
+        # per-topic arrival order WITHIN a family (the serial ordering
+        # contract is per-topic; cross-topic order was never guaranteed —
+        # send_many already interleaves topics). Pre-encoded items pass
+        # through at their arrival position. Caveat, by design: a topic
+        # carrying BOTH batchable and unbatchable payloads in one flush
+        # may reorder across the kinds (the group anchors at its first
+        # message) — no shipped topic mixes kinds (invoker topics carry
+        # activations, completed* topics carry acks, health/events stay
+        # per-frame), and consumers of each kind are order-independent
+        # across the other.
+        items: list = []
+        groups: dict = {}
+        for (topic, payload_or_family, msg), fut in batch:
+            if isinstance(payload_or_family, str):
+                key = (topic, payload_or_family)
+                grp = groups.get(key)
+                if grp is None:
+                    grp = groups[key] = []
+                    # placeholder keeps this group's position in the
+                    # flush order (first appearance of the topic)
+                    items.append(key)
+                grp.append((msg, fut))
+            else:
+                items.append((topic, payload_or_family, msg))
+        out: list = []
+        for it in items:
+            if isinstance(it, tuple) and len(it) == 2:
+                topic, family = it
+                group = groups[(topic, family)]
+                msgs = [m for (m, _f) in group]
+                if len(msgs) == 1:
+                    # a lone message pays the plain wire format — the
+                    # decode side needs no batch frame for N=1 and the
+                    # serial consumers stay compatible
+                    try:
+                        out.append((topic, encode_message(msgs[0]),
+                                    msgs[0]))
+                    except Exception as e:  # noqa: BLE001
+                        self._fail_group(group, e)
+                    continue
+                try:
+                    payload, batch_msg = encode_batch(family, msgs)
+                except Exception:  # noqa: BLE001 — deferring the encode
+                    # to flush time must NOT widen one bad message's
+                    # blast radius to the whole flush (the serial path
+                    # charged a serialize failure to its sender): retry
+                    # each message alone so only the unserializable ones
+                    # fail, and the rest still ship
+                    for m, fut in group:
+                        try:
+                            out.append((topic, encode_message(m), m))
+                        except Exception as e:  # noqa: BLE001
+                            if not fut.done():
+                                fut.set_exception(e)
+                    continue
+                _STATS["wire_batches"] += 1
+                _STATS["wire_batched_messages"] += len(msgs)
+                out.append((topic, payload, batch_msg))
+            else:
+                out.append(it)
+        await self.inner.send_many(out)
+
+    @staticmethod
+    def _fail_group(group, exc) -> None:
+        for _m, fut in group:
+            if not fut.done():
+                fut.set_exception(exc)
 
     async def flush(self) -> None:
         """Wait until everything enqueued so far has shipped (or failed)."""
@@ -117,7 +236,8 @@ def maybe_coalesce(producer: MessageProducer,
     cfg = config if config is not None else BusCoalesceConfig.from_env()
     if not cfg.enabled or isinstance(producer, CoalescingProducer):
         return producer
-    return CoalescingProducer(producer, cfg.max_batch, cfg.window_ms)
+    return CoalescingProducer(producer, cfg.max_batch, cfg.window_ms,
+                              batch_wire=cfg.batch_wire)
 
 
 def export_coalesce_gauges(metrics) -> None:
@@ -127,3 +247,6 @@ def export_coalesce_gauges(metrics) -> None:
     metrics.gauge("bus_coalesce_batches", _STATS["batches"])
     metrics.gauge("bus_coalesce_messages", _STATS["messages"])
     metrics.gauge("bus_coalesce_batch_max", _STATS["max_batch"])
+    metrics.gauge("bus_wire_batches", _STATS["wire_batches"])
+    metrics.gauge("bus_wire_batched_messages",
+                  _STATS["wire_batched_messages"])

@@ -1,0 +1,315 @@
+"""Columnar batch wire records: one encoded frame for a whole micro-batch.
+
+ISSUE 12's tentpole: the activation BATCH — not the activation — is the
+unit of work on every host hop. The coalescing producer already ships one
+`pubN` frame per micro-batch, but each sub-message inside it is still an
+independently-JSON-encoded ActivationMessage / ack: at 1,000 activations/s
+the host pays ~N `json.dumps` + N `json.loads` per hop, plus N parses of
+the SAME identity/action/controller sub-objects (the host observatory
+measured the serde plane at ~7.7% of wall per hop at 512/s, before
+counting the per-message object construction it feeds).
+
+This module is the wire half of the columnar hot path:
+
+  * `ActivationBatchMessage` — N controller->invoker dispatches packed as
+    ONE struct-of-arrays JSON record: per-batch dedup tables for the
+    repeated heavy sub-objects (users, (action, revision) pairs,
+    controller ids) and packed per-row columns (activation ids, user /
+    action indices, transids, blocking bits, arg payloads — the arg
+    column is the "one blob" of the packed form: a single `json.dumps`
+    writes every row's args in one C-speed pass, and sparse columns
+    carry the rarely-present fields). ONE serialize per batch; the
+    decode side rebuilds N `ActivationMessage`s parsing each unique
+    identity/action exactly once.
+  * `AckBatchMessage` — the mirror record for the invoker->controller
+    completion fan-in (kinds, transids, ids, invoker dedup, system-error
+    bits, response payloads).
+  * `is_batch_payload` / `batch_hop_of` — frame sniffing for consumers:
+    every batch payload starts with the `{"whiskBatch":` magic, so a
+    feed handler can route a frame to the batch decode without parsing
+    it (plain per-message frames never start with that key — neither
+    ActivationMessage nor the acks serialize a `whiskBatch` field
+    first, and json.dumps key order is insertion order).
+
+Off switch: the batch wire rides the coalescing producer
+(`CONFIG_whisk_bus_coalesce_batchWire=false` restores one independently
+encoded payload per message — the serial wire format, byte-exact).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..core.entity import ActivationId, ControllerInstanceId, Identity
+from ..core.entity.names import FullyQualifiedEntityName
+from ..utils.transaction import TransactionId
+from .message import (AcknowledgementMessage, ActivationMessage,
+                      CombinedCompletionAndResultMessage, CompletionMessage,
+                      Message, ResultMessage)
+
+#: every batch payload leads with this key (json.dumps preserves insertion
+#: order, so the magic is a stable byte prefix — the cheap routing test)
+BATCH_MAGIC = b'{"whiskBatch":'
+
+KIND_ACTIVATION = "act1"
+KIND_ACK = "ack1"
+
+#: serde hop labels by batch kind (mirrors connector._SERDE_HOPS so the
+#: host observatory's per-hop accounting survives the batch wire)
+_BATCH_HOPS = {KIND_ACTIVATION: "activation", KIND_ACK: "completion_ack"}
+
+
+def is_batch_payload(raw) -> bool:
+    """True when `raw` is a batch wire record (magic-prefix sniff; no
+    parse). Accepts bytes/bytearray/str."""
+    if isinstance(raw, str):
+        return raw.startswith('{"whiskBatch":')
+    return bytes(raw[:len(BATCH_MAGIC)]) == BATCH_MAGIC
+
+
+def batch_hop_of(kind: str) -> str:
+    return _BATCH_HOPS.get(kind, "other")
+
+
+def batchable_family(msg) -> Optional[str]:
+    """The batch family a message coalesces into, or None for messages
+    that stay per-frame (pings, events: background chatter whose framing
+    is not on the hot path)."""
+    if isinstance(msg, ActivationMessage):
+        return KIND_ACTIVATION
+    if isinstance(msg, AcknowledgementMessage):
+        return KIND_ACK
+    return None
+
+
+class _Dedup:
+    """Insertion-ordered dedup table: intern() returns the index of the
+    (hashable) key, appending `value` on first sight."""
+
+    __slots__ = ("index", "values")
+
+    def __init__(self):
+        self.index: Dict[object, int] = {}
+        self.values: List[object] = []
+
+    def intern(self, key, value) -> int:
+        i = self.index.get(key)
+        if i is None:
+            i = len(self.values)
+            self.index[key] = i
+            self.values.append(value)
+        return i
+
+
+class ActivationBatchMessage(Message):
+    """N ActivationMessages as one columnar wire record (see module doc).
+
+    The struct-of-arrays layout: `users`/`actions`/`ctrls` are per-batch
+    dedup tables (each unique identity / (fqn, revision) / controller
+    encoded ONCE); `ids`, `u`, `a`, `c`, `tx`, `bl`, `args` are
+    length-N columns; `cause`/`trace`/`init` are sparse {row: value}
+    columns present only when some row carries the field. `fence` is the
+    batch-level HA epoch (one controller's flush shares one epoch; a
+    rare mixed-epoch flush falls back to a sparse per-row column)."""
+
+    def __init__(self, msgs: List[ActivationMessage]):
+        self.msgs = msgs
+
+    #: the waterfall produce edge stamps per activation: connector
+    #: stamp_produce reads this instead of .activation_id
+    @property
+    def activation_ids(self) -> List[str]:
+        return [m.activation_id.asString for m in self.msgs]
+
+    def to_json(self) -> dict:
+        users, actions, ctrls = _Dedup(), _Dedup(), _Dedup()
+        ids: List[str] = []
+        u_col: List[int] = []
+        a_col: List[int] = []
+        c_col: List[int] = []
+        tx_col: List[object] = []
+        bl_col: List[int] = []
+        args_col: List[Optional[dict]] = []
+        cause: Dict[str, str] = {}
+        trace: Dict[str, dict] = {}
+        init: Dict[str, dict] = {}
+        fences: Dict[str, int] = {}
+        for row, m in enumerate(self.msgs):
+            ids.append(m.activation_id.asString)
+            # identity dedup keys on the subject+namespace-uuid pair (the
+            # stable identity key); the action table keys on (fqn, rev)
+            ident = m.user
+            u_col.append(users.intern(
+                (ident.subject, ident.namespace.uuid.asString),
+                ident.to_json()))
+            a_col.append(actions.intern((str(m.action), m.revision),
+                                        [str(m.action), m.revision]))
+            c_col.append(ctrls.intern(m.root_controller_index.name,
+                                      m.root_controller_index.name))
+            tx_col.append(m.transid.to_json())
+            bl_col.append(1 if m.blocking else 0)
+            args_col.append(m.content)
+            if m.cause is not None:
+                cause[str(row)] = m.cause.to_json()
+            if m.trace_context is not None:
+                trace[str(row)] = m.trace_context
+            if m.init_args:
+                init[str(row)] = m.init_args
+            if m.fence_epoch is not None:
+                fences[str(row)] = m.fence_epoch
+        out = {
+            "whiskBatch": KIND_ACTIVATION,
+            "users": users.values,
+            "actions": actions.values,
+            "ctrls": ctrls.values,
+            "ids": ids,
+            "u": u_col, "a": a_col, "c": c_col,
+            "tx": tx_col, "bl": bl_col,
+            "args": args_col,
+        }
+        if cause:
+            out["cause"] = cause
+        if trace:
+            out["trace"] = trace
+        if init:
+            out["init"] = init
+        if fences:
+            # the common case is one shared epoch: collapse to a scalar
+            vals = set(fences.values())
+            if len(vals) == 1 and len(fences) == len(self.msgs):
+                out["fence"] = vals.pop()
+            else:
+                out["fences"] = fences
+        return out
+
+    @staticmethod
+    def parse(raw) -> List[ActivationMessage]:
+        """One json.loads + shared-subobject reconstruction: each unique
+        identity/action/controller in the batch is parsed exactly once
+        and the rebuilt objects are SHARED across the batch's messages
+        (read-only on the consume side, like the reference's case
+        classes)."""
+        j = json.loads(raw)
+        return ActivationBatchMessage.from_json(j)
+
+    @staticmethod
+    def from_json(j: dict) -> List[ActivationMessage]:
+        users = [Identity.from_json(u) for u in j["users"]]
+        actions = [(FullyQualifiedEntityName.parse(a), rev)
+                   for a, rev in j["actions"]]
+        ctrls = [ControllerInstanceId(c) for c in j["ctrls"]]
+        cause = j.get("cause") or {}
+        trace = j.get("trace") or {}
+        init = j.get("init") or {}
+        fence = j.get("fence")
+        fences = j.get("fences") or {}
+        out: List[ActivationMessage] = []
+        for row, (aid, u, a, c, tx, bl, args) in enumerate(zip(
+                j["ids"], j["u"], j["a"], j["c"], j["tx"], j["bl"],
+                j["args"])):
+            key = str(row)
+            fqn, rev = actions[a]
+            row_cause = cause.get(key)
+            out.append(ActivationMessage(
+                TransactionId.from_json(tx), fqn, rev, users[u],
+                ActivationId(aid), ctrls[c], bool(bl), args,
+                init.get(key) or {},
+                ActivationId(row_cause) if row_cause else None,
+                trace.get(key),
+                fence if fence is not None else fences.get(key)))
+        return out
+
+
+#: ack kind -> wire code (one char per row in the kinds column)
+_ACK_CODES = {"completion": "c", "result": "r", "combined": "b"}
+_ACK_KINDS = {v: k for k, v in _ACK_CODES.items()}
+
+
+class AckBatchMessage(Message):
+    """N invoker->controller acks as one columnar wire record. The heavy
+    per-row payload (the WhiskActivation response) stays per-row — it IS
+    the data — but the batch pays ONE json.dumps/loads for all of them,
+    and the invoker table dedups the repeated instance id."""
+
+    def __init__(self, msgs: List[AcknowledgementMessage]):
+        self.msgs = msgs
+
+    @property
+    def activation_ids(self) -> List[str]:
+        return [m.activation_id.asString for m in self.msgs]
+
+    def to_json(self) -> dict:
+        invs = _Dedup()
+        kinds: List[str] = []
+        tx_col: List[object] = []
+        ids: List[str] = []
+        iv_col: List[int] = []
+        err_col: List[int] = []
+        resp_col: List[Optional[dict]] = []
+        for m in self.msgs:
+            kinds.append(_ACK_CODES.get(m.kind, "b"))
+            tx_col.append(m.transid.to_json())
+            ids.append(m.activation_id.asString)
+            iv_col.append(-1 if m.invoker is None
+                          else invs.intern(m.invoker.as_string,
+                                           m.invoker.to_json()))
+            err_col.append(1 if m.is_system_error else 0)
+            resp_col.append(m.activation.to_json()
+                            if m.activation is not None else None)
+        return {
+            "whiskBatch": KIND_ACK,
+            "invs": invs.values,
+            "kinds": "".join(kinds),
+            "tx": tx_col, "ids": ids, "iv": iv_col, "err": err_col,
+            "resp": resp_col,
+        }
+
+    @staticmethod
+    def parse(raw) -> List[AcknowledgementMessage]:
+        j = json.loads(raw)
+        return AckBatchMessage.from_json(j)
+
+    @staticmethod
+    def from_json(j: dict) -> List[AcknowledgementMessage]:
+        from ..core.entity import InvokerInstanceId, WhiskActivation
+        invs = [InvokerInstanceId.from_json(v) for v in j["invs"]]
+        out: List[AcknowledgementMessage] = []
+        for code, tx, aid, iv, err, resp in zip(
+                j["kinds"], j["tx"], j["ids"], j["iv"], j["err"],
+                j["resp"]):
+            transid = TransactionId.from_json(tx)
+            inv = invs[iv] if iv >= 0 else None
+            act = WhiskActivation.from_json(resp) if resp else None
+            kind = _ACK_KINDS.get(code, "combined")
+            if kind == "completion":
+                out.append(CompletionMessage(transid, ActivationId(aid),
+                                             bool(err), inv))
+            elif kind == "result":
+                out.append(ResultMessage(transid, act))
+            else:
+                out.append(CombinedCompletionAndResultMessage(transid, act,
+                                                              inv))
+        return out
+
+
+def make_batch(family: str, msgs: list) -> Message:
+    """Wrap same-family messages into their batch record (the
+    `serialize_many` entry point the coalescing producer uses)."""
+    if family == KIND_ACTIVATION:
+        return ActivationBatchMessage(msgs)
+    if family == KIND_ACK:
+        return AckBatchMessage(msgs)
+    raise ValueError(f"not a batchable family: {family!r}")
+
+
+def parse_batch(raw) -> Tuple[str, list]:
+    """Decode one batch payload -> (kind, [messages]). The caller sniffs
+    with is_batch_payload first; an unknown kind raises ValueError (the
+    feed's corrupt-message posture)."""
+    j = json.loads(raw)
+    kind = j.get("whiskBatch")
+    if kind == KIND_ACTIVATION:
+        return kind, ActivationBatchMessage.from_json(j)
+    if kind == KIND_ACK:
+        return kind, AckBatchMessage.from_json(j)
+    raise ValueError(f"unknown batch kind {kind!r}")

@@ -67,11 +67,50 @@ def decode_message(parse, raw, hop: str):
     return msg
 
 
+def encode_batch(family: str, msgs: list) -> Tuple[bytes, object]:
+    """ONE serialize for a whole same-family micro-batch (the columnar
+    batch wire, messaging/columnar.py). Returns (payload, batch_message);
+    the host observatory books the batch's bytes + wall time under the
+    SAME hop label as N serial encodes would have used — so the serde
+    counters stay comparable across the knob, and the per-hop byte totals
+    measure the dedup win directly."""
+    from .columnar import batch_hop_of, make_batch
+    batch_msg = make_batch(family, msgs)
+    obs = GLOBAL_HOST_OBSERVATORY
+    if not obs.serde_active:
+        return batch_msg.serialize(), batch_msg
+    t0 = time.perf_counter_ns()
+    payload = batch_msg.serialize()
+    obs.serde_observe(batch_hop_of(family), "serialize", len(payload),
+                      time.perf_counter_ns() - t0)
+    return payload, batch_msg
+
+
+def decode_batch(raw):
+    """Decode one batch payload -> (kind, [messages]) with the matching
+    deserialize-side accounting (one observe for the whole frame)."""
+    from .columnar import batch_hop_of, parse_batch
+    obs = GLOBAL_HOST_OBSERVATORY
+    if not obs.serde_active:
+        return parse_batch(raw)
+    t0 = time.perf_counter_ns()
+    kind, msgs = parse_batch(raw)
+    obs.serde_observe(batch_hop_of(kind), "deserialize", len(raw),
+                      time.perf_counter_ns() - t0)
+    return kind, msgs
+
+
 def stamp_produce(msg) -> None:
     """Waterfall `produce` edge, shared by every bus backend's producer:
     first-wins, so only the controller->invoker hand-off sets it (the
     completion ack also carries an activation_id but lands second, and
-    cross-process peers stamp into an empty map — a no-op)."""
+    cross-process peers stamp into an empty map — a no-op). Batch wire
+    records carry `activation_ids` and stamp the whole batch at one
+    shared timestamp."""
+    aids = getattr(msg, "activation_ids", None)
+    if aids is not None:
+        GLOBAL_WATERFALL.stamp_many(aids, STAGE_PRODUCE)
+        return
     aid = getattr(msg, "activation_id", None)
     if aid is not None:
         GLOBAL_WATERFALL.stamp(aid.asString, STAGE_PRODUCE)
@@ -81,6 +120,13 @@ class MessageProducer:
     async def send(self, topic: str, msg) -> None:
         """Send a Message (or raw bytes) to a topic."""
         raise NotImplementedError
+
+    async def send_batch(self, topic: str, msgs) -> None:
+        """Send a wave of messages to ONE topic. The CoalescingProducer
+        overrides this task-free (one await for the whole wave); the
+        default keeps serial semantics."""
+        for m in msgs:
+            await self.send(topic, m)
 
     async def send_many(self, items) -> None:
         """Ship a pre-serialized micro-batch `[(topic, payload_bytes, msg)]`
@@ -184,6 +230,17 @@ class MessageFeed:
         """Handler signals one unit of capacity is free again."""
         self._free += 1
         self._wake.set()
+
+    def consume_extra(self, n: int) -> None:
+        """A handler discovered its ONE payload carries `1 + n` logical
+        messages (a columnar batch frame): book the extra capacity so the
+        feed's backpressure still counts messages, not frames. Each
+        logical message then releases via processed() as it completes.
+        May drive _free negative under a large frame — the pump simply
+        waits until enough releases land, which is the intended
+        backpressure."""
+        if n > 0:
+            self._free -= n
 
     async def _pump(self) -> None:
         try:

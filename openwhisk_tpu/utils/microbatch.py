@@ -52,13 +52,20 @@ class MicroCoalescer:
         return len(self._pending)
 
     async def submit(self, item) -> None:
+        await self.submit_nowait(item)
+
+    def submit_nowait(self, item) -> asyncio.Future:
+        """Enqueue without awaiting; returns the item's flush future.
+        Callers submitting a whole wave await the futures together
+        (`asyncio.gather(*futs)` over FUTURES costs no task per item —
+        gather only wraps coroutines in tasks)."""
         loop = asyncio.get_event_loop()
         fut: asyncio.Future = loop.create_future()
         self._pending.append((item, fut, loop.time()))
         if len(self._pending) >= self.max_batch:
             self._full.set()  # wake a drainer sleeping out its window
         self._arm()
-        await fut
+        return fut
 
     def _arm(self) -> None:
         if self._drainer is None or self._drainer.done():

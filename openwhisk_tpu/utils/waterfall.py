@@ -248,13 +248,8 @@ class ActivationWaterfall:
         return len(self._active)
 
     # -- finish: fold one activation into the aggregates -------------------
-    def finish(self, aid: str) -> Optional[dict]:
-        """Fold the stage vector into the histograms and file the row.
-        Called when the completion ack lands (the last causally-ordered
-        stage); a record_write stamped later finds nothing and no-ops."""
-        ctx = self._active.pop(aid, None)
-        if ctx is None:
-            return None
+    def _compute_row(self, aid: str, ctx: list) -> Optional[dict]:
+        """The lock-free half of finish(): stage deltas + the row dict."""
         t0 = ctx[_CTX_T0]
         deltas_us = [0] * N_STAGES
         stamped = 0
@@ -278,7 +273,7 @@ class ActivationWaterfall:
         if stamped == 0:
             return None
         total_us = max(0, (prev - t0) // 1000)
-        row = {
+        return {
             "activation_id": aid,
             "trace_id": ctx[_CTX_TRACE],
             "ts": time.time(),
@@ -286,31 +281,68 @@ class ActivationWaterfall:
             "deltas_us": deltas_us,
             "clamped": clamped,
         }
+
+    def _fold_locked(self, row: dict) -> None:
+        """Fold one computed row into the aggregates (self._lock held)."""
         nb = self.n_buckets
+        deltas_us = row["deltas_us"]
+        total_us = row["total_us"]
+        dom, dom_delta = -1, -1
+        for i in range(N_STAGES):
+            d = deltas_us[i]
+            if d < 0:
+                continue
+            self._hist[i][bucket_of_us(d, nb)] += 1
+            self._sum_us[i] += d
+            self._stage_count[i] += 1
+            if d > dom_delta:
+                dom, dom_delta = i, d
+        tb = bucket_of_us(total_us, nb)
+        self._total_hist[tb] += 1
+        self._total_sum_us += total_us
+        if dom >= 0:
+            self._dominant[dom] += 1
+            if tb >= self._tail_bucket:
+                self._dominant_tail[dom] += 1
+        self._finished += 1
+        if self._finished % _TAIL_REFRESH == 0:
+            self._tail_bucket = self._pctl_bucket(self._total_hist, 0.99)
+        self._ring.append(row)
+        self._note_slow(total_us, row)
+
+    def finish(self, aid: str) -> Optional[dict]:
+        """Fold the stage vector into the histograms and file the row.
+        Called when the completion ack lands (the last causally-ordered
+        stage); a record_write stamped later finds nothing and no-ops."""
+        ctx = self._active.pop(aid, None)
+        if ctx is None:
+            return None
+        row = self._compute_row(aid, ctx)
+        if row is None:
+            return None
         with self._lock:
-            dom, dom_delta = -1, -1
-            for i in range(N_STAGES):
-                d = deltas_us[i]
-                if d < 0:
-                    continue
-                self._hist[i][bucket_of_us(d, nb)] += 1
-                self._sum_us[i] += d
-                self._stage_count[i] += 1
-                if d > dom_delta:
-                    dom, dom_delta = i, d
-            tb = bucket_of_us(total_us, nb)
-            self._total_hist[tb] += 1
-            self._total_sum_us += total_us
-            if dom >= 0:
-                self._dominant[dom] += 1
-                if tb >= self._tail_bucket:
-                    self._dominant_tail[dom] += 1
-            self._finished += 1
-            if self._finished % _TAIL_REFRESH == 0:
-                self._tail_bucket = self._pctl_bucket(self._total_hist, 0.99)
-            self._ring.append(row)
-            self._note_slow(total_us, row)
+            self._fold_locked(row)
         return row
+
+    def finish_many(self, aids) -> int:
+        """The batch-shaped completion path's fold: N finishes under ONE
+        lock acquisition (the per-ack lock round trip was real work at
+        thousands of completions/s). Semantically identical to calling
+        finish() per id; returns how many rows folded."""
+        rows = []
+        pop = self._active.pop
+        for aid in aids:
+            ctx = pop(aid, None)
+            if ctx is not None:
+                row = self._compute_row(aid, ctx)
+                if row is not None:
+                    rows.append(row)
+        if not rows:
+            return 0
+        with self._lock:
+            for row in rows:
+                self._fold_locked(row)
+        return len(rows)
 
     def _note_slow(self, total_us: int, row: dict) -> None:
         sl = self._slowest

@@ -59,10 +59,18 @@ class SimInvoker:
         box = {}
 
         async def handle(payload: bytes):
-            msg = ActivationMessage.parse(payload)
-            self.handled.append(msg)
+            # the batch wire ships one columnar frame per coalesced
+            # micro-batch (messaging/columnar.py); lone messages still
+            # arrive in the plain per-message format
+            from openwhisk_tpu.messaging.columnar import (is_batch_payload,
+                                                          parse_batch)
+            if is_batch_payload(payload):
+                _kind, msgs = parse_batch(payload)
+            else:
+                msgs = [ActivationMessage.parse(payload)]
+            self.handled.extend(msgs)
 
-            async def finish():
+            async def finish(msg):
                 if self.delay:
                     await asyncio.sleep(self.delay)
                 now = time.time()
@@ -75,7 +83,8 @@ class SimInvoker:
                     CombinedCompletionAndResultMessage(msg.transid, act,
                                                        self.instance))
                 box["feed"].processed()
-            asyncio.get_event_loop().create_task(finish())
+            for msg in msgs:
+                asyncio.get_event_loop().create_task(finish(msg))
 
         self._feed = MessageFeed(topic, consumer, 64, handle)
         box["feed"] = self._feed

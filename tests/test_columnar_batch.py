@@ -1,0 +1,570 @@
+"""ISSUE 12: columnar hot path — batch wire records, batch-shaped
+completion pipeline, sharded front end.
+
+Covers the acceptance contracts:
+  * wire parity: a batch record decodes to field-identical messages
+    (fuzzed over optional columns), and every batch payload sniffs as
+    one while plain payloads never do;
+  * encode-exactly-once: a message riding a batch frame is serialized
+    once, at flush, with the serde byte counters seeing exactly the
+    batch payload's bytes;
+  * off-switches: batchWire=false ships byte-identical serial payloads;
+    batchedAck=false replays a decoded frame through the serial per-ack
+    path with identical state transitions;
+  * out-of-order / partial batch acks: a completion frame spanning two
+    dispatch batches, and a frame holding an ack for an evicted entry,
+    must not desync the waterfall stamps or the inflight gauge;
+  * sharded front end: shards=1 builds nothing (bit-exact default);
+    shards>=2 decides per-namespace sequences exactly like the serial
+    path (parity fuzz) and propagates the serial exceptions.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from openwhisk_tpu.controller.entitlement import (ACTIVATE,
+                                                  LocalEntitlementProvider,
+                                                  ThrottleRejectRequest)
+from openwhisk_tpu.controller.frontend import (FrontendConfig,
+                                               FrontendShardPlane,
+                                               maybe_shard_frontend)
+from openwhisk_tpu.core.entity import (ActivationId, ActivationResponse,
+                                       ControllerInstanceId, EntityPath,
+                                       Identity, InvokerInstanceId, MB,
+                                       WhiskActivation)
+from openwhisk_tpu.core.entity.names import FullyQualifiedEntityName
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+from openwhisk_tpu.messaging.coalesce import CoalescingProducer
+from openwhisk_tpu.messaging.columnar import (ActivationBatchMessage,
+                                              AckBatchMessage,
+                                              KIND_ACK, KIND_ACTIVATION,
+                                              batchable_family,
+                                              is_batch_payload, make_batch,
+                                              parse_batch)
+from openwhisk_tpu.messaging.message import (ActivationMessage,
+                                             CombinedCompletionAndResultMessage,
+                                             CompletionMessage, PingMessage,
+                                             ResultMessage)
+from openwhisk_tpu.utils.transaction import TransactionId
+from openwhisk_tpu.utils.waterfall import (ActivationWaterfall,
+                                           STAGE_COMPLETION_ACK,
+                                           STAGE_PUBLISH_ENQUEUE,
+                                           WaterfallConfig)
+
+
+def _ident(ns="guest"):
+    return Identity.generate(ns)
+
+
+def _act_msg(ident, name="act0", i=0, **kw):
+    return ActivationMessage(
+        TransactionId(), FullyQualifiedEntityName.parse(f"guest/{name}"),
+        "1-b", ident, ActivationId.generate(), ControllerInstanceId("0"),
+        bool(i % 2), {"x": i}, **kw)
+
+
+def _activation(ident, msg):
+    now = time.time()
+    return WhiskActivation(
+        EntityPath("guest"), msg.action.name, ident.subject,
+        msg.activation_id, now, now,
+        ActivationResponse.success({"ok": True}), duration=1)
+
+
+def _msg_fields(m: ActivationMessage) -> dict:
+    j = m.to_json()
+    return j
+
+
+class TestBatchWireRecords:
+    def test_activation_batch_roundtrip_fuzz(self):
+        rng = random.Random(7)
+        idents = [_ident(f"ns{k}") for k in range(3)]
+        for trial in range(20):
+            msgs = []
+            for i in range(rng.randint(1, 12)):
+                kw = {}
+                if rng.random() < 0.3:
+                    kw["cause"] = ActivationId.generate()
+                if rng.random() < 0.3:
+                    kw["trace_context"] = {"traceparent": f"00-{i}"}
+                if rng.random() < 0.3:
+                    kw["init_args"] = {"k": i}
+                if rng.random() < 0.5:
+                    kw["fence_epoch"] = rng.choice([3, 3, 7])
+                msgs.append(_act_msg(idents[rng.randrange(3)],
+                                     name=f"a{i % 4}", i=i, **kw))
+            raw = ActivationBatchMessage(msgs).serialize()
+            assert is_batch_payload(raw)
+            kind, out = parse_batch(raw)
+            assert kind == KIND_ACTIVATION
+            assert len(out) == len(msgs)
+            for a, b in zip(msgs, out):
+                assert _msg_fields(a) == _msg_fields(b)
+
+    def test_ack_batch_roundtrip_all_kinds(self):
+        ident = _ident()
+        inv = InvokerInstanceId(0, user_memory=MB(512))
+        msgs = [_act_msg(ident, i=i) for i in range(3)]
+        acks = [
+            CompletionMessage(msgs[0].transid, msgs[0].activation_id, True,
+                              inv),
+            ResultMessage(msgs[1].transid, _activation(ident, msgs[1])),
+            CombinedCompletionAndResultMessage(
+                msgs[2].transid, _activation(ident, msgs[2]), inv),
+        ]
+        raw = AckBatchMessage(acks).serialize()
+        assert is_batch_payload(raw)
+        kind, out = parse_batch(raw)
+        assert kind == KIND_ACK
+        for a, b in zip(acks, out):
+            assert a.kind == b.kind
+            assert a.activation_id == b.activation_id
+            assert a.is_system_error == b.is_system_error
+            assert (a.invoker is None) == (b.invoker is None)
+            if a.invoker is not None:
+                assert a.invoker.as_string == b.invoker.as_string
+            assert (a.activation is None) == (b.activation is None)
+            if a.activation is not None:
+                # `updated` is stamped at to_json() call time — exclude
+                ja = a.activation.to_json()
+                jb = b.activation.to_json()
+                ja.pop("updated"), jb.pop("updated")
+                assert ja == jb
+
+    def test_plain_payloads_never_sniff_as_batch(self):
+        ident = _ident()
+        msg = _act_msg(ident)
+        assert not is_batch_payload(msg.serialize())
+        ack = CombinedCompletionAndResultMessage(
+            msg.transid, _activation(ident, msg),
+            InvokerInstanceId(0, user_memory=MB(512)))
+        assert not is_batch_payload(ack.serialize())
+        assert not is_batch_payload(PingMessage(
+            InvokerInstanceId(0, user_memory=MB(512))).serialize())
+
+    def test_batchable_family(self):
+        ident = _ident()
+        msg = _act_msg(ident)
+        assert batchable_family(msg) == KIND_ACTIVATION
+        assert batchable_family(
+            ResultMessage(msg.transid, _activation(ident, msg))) == KIND_ACK
+        assert batchable_family(PingMessage(
+            InvokerInstanceId(0, user_memory=MB(512)))) is None
+
+    def test_dedup_tables_shrink_the_frame(self):
+        """The columnar record's dedup must beat N serial encodes on a
+        same-user batch — that IS the serde win being shipped."""
+        ident = _ident()
+        msgs = [_act_msg(ident, name=f"a{i % 2}", i=i) for i in range(16)]
+        batch_bytes = len(ActivationBatchMessage(msgs).serialize())
+        serial_bytes = sum(len(m.serialize()) for m in msgs)
+        assert batch_bytes < serial_bytes / 2
+
+
+class _SpyProducer:
+    """Records send_many items; no transport."""
+
+    def __init__(self):
+        self.shipped = []
+
+    async def send_many(self, items):
+        self.shipped.append(list(items))
+
+    async def send(self, topic, msg):
+        await self.send_many([(topic, msg if isinstance(msg, bytes)
+                               else msg.serialize(), msg)])
+
+    async def close(self):
+        pass
+
+    @property
+    def sent_count(self):
+        return sum(len(b) for b in self.shipped)
+
+
+class TestCoalescerBatchWire:
+    def _drive(self, batch_wire: bool, msgs, topic="invoker0"):
+        async def go():
+            spy = _SpyProducer()
+            prod = CoalescingProducer(spy, max_batch=64,
+                                      batch_wire=batch_wire)
+            await asyncio.gather(*[prod.send(topic, m) for m in msgs])
+            await prod.flush()
+            return spy.shipped
+
+        return asyncio.run(go())
+
+    def test_batch_wire_one_payload_per_topic(self):
+        ident = _ident()
+        msgs = [_act_msg(ident, i=i) for i in range(8)]
+        shipped = self._drive(True, msgs)
+        items = [it for batch in shipped for it in batch]
+        assert len(items) == 1
+        topic, payload, batch_msg = items[0]
+        assert is_batch_payload(payload)
+        _kind, out = parse_batch(payload)
+        assert [m.activation_id.asString for m in out] == \
+            [m.activation_id.asString for m in msgs]
+        # the batch message exposes the ids for the produce stamp
+        assert batch_msg.activation_ids == \
+            [m.activation_id.asString for m in msgs]
+
+    def test_off_switch_serial_payloads_byte_exact(self):
+        ident = _ident()
+        msgs = [_act_msg(ident, i=i) for i in range(4)]
+        shipped = self._drive(False, msgs)
+        items = [it for batch in shipped for it in batch]
+        assert len(items) == 4
+        for (topic, payload, m), orig in zip(items, msgs):
+            assert payload == orig.serialize()
+
+    def test_lone_message_stays_plain_format(self):
+        ident = _ident()
+        shipped = self._drive(True, [_act_msg(ident)])
+        items = [it for batch in shipped for it in batch]
+        assert len(items) == 1
+        assert not is_batch_payload(items[0][1])
+
+    def test_unbatchable_messages_pass_through(self):
+        inv = InvokerInstanceId(0, user_memory=MB(512))
+        shipped = self._drive(True, [PingMessage(inv), PingMessage(inv)],
+                              topic="health")
+        items = [it for batch in shipped for it in batch]
+        assert len(items) == 2
+        for _t, payload, m in items:
+            assert not is_batch_payload(payload)
+
+    def test_encode_exactly_once_byte_counted(self):
+        """The satellite contract: with the batch wire on, a batched
+        message is encoded exactly once — the serde serialize counter
+        books exactly the batch payload's bytes, not N message encodes
+        plus a re-frame."""
+        from openwhisk_tpu.utils.hostprof import GLOBAL_HOST_OBSERVATORY
+
+        ident = _ident()
+        msgs = [_act_msg(ident, i=i) for i in range(6)]
+        obs = GLOBAL_HOST_OBSERVATORY
+        was_enabled = obs.enabled
+        obs.enabled = True
+        obs.reset()
+        try:
+            shipped = self._drive(True, msgs)
+            items = [it for b in shipped for it in b]
+            payload = items[0][1]
+            snap = obs.snapshot()
+            row = {(r["hop"], r["direction"]): r
+                   for r in snap.get("serde", [])}
+            ser = row.get(("activation", "serialize"))
+            assert ser is not None
+            assert ser["count"] == 1
+            assert ser["bytes"] == len(payload)
+        finally:
+            obs.enabled = was_enabled
+            obs.reset()
+
+    def test_send_batch_resolves_per_item(self):
+        """send_batch awaits one gather over futures; a flush failure
+        still propagates to the caller."""
+        ident = _ident()
+
+        class _Boom(_SpyProducer):
+            async def send_many(self, items):
+                raise RuntimeError("bus down")
+
+        async def go():
+            prod = CoalescingProducer(_Boom(), max_batch=8,
+                                      batch_wire=True)
+            with pytest.raises(RuntimeError):
+                await prod.send_batch("t", [_act_msg(ident, i=i)
+                                            for i in range(3)])
+
+        asyncio.run(go())
+
+
+def _mk_balancer(monkeypatch=None, batched_ack=True):
+    """A CommonLoadBalancer with stub planes, enough for ack processing."""
+    from openwhisk_tpu.controller.loadbalancer.base import CommonLoadBalancer
+    from openwhisk_tpu.utils.waterfall import ActivationWaterfall
+
+    provider = MemoryMessagingProvider()
+    bal = CommonLoadBalancer(provider, ControllerInstanceId("0"),
+                             waterfall=ActivationWaterfall(
+                                 WaterfallConfig(enabled=True)))
+    bal.batched_ack = batched_ack
+    return bal
+
+
+class TestBatchedAckPipeline:
+    def _setup_entries(self, bal, n, action=None):
+        import bench
+        ident = _ident()
+        action = action or bench._bench_action("b0", memory=128)
+        inv = InvokerInstanceId(0, user_memory=MB(512))
+        msgs = []
+        for i in range(n):
+            m = _act_msg(ident, name="b0", i=i)
+            bal.waterfall.begin(m.activation_id.asString)
+            bal.waterfall.stamp(m.activation_id.asString,
+                                STAGE_PUBLISH_ENQUEUE)
+            bal.setup_activation(m, action, inv)
+            msgs.append(m)
+        return msgs, inv, ident
+
+    def test_batch_ack_frame_completes_all(self):
+        async def go():
+            bal = _mk_balancer()
+            msgs, inv, ident = self._setup_entries(bal, 5)
+            acks = [CombinedCompletionAndResultMessage(
+                m.transid, _activation(ident, m), inv) for m in msgs]
+            raw = AckBatchMessage(acks).serialize()
+            bal.process_acknowledgement_frame(raw)
+            assert bal.total_active_activations == 0
+            assert not bal.activation_slots
+            # every stage vector folded exactly once
+            assert bal.waterfall._finished == 5
+            assert bal.waterfall.active == 0
+            assert bal.metrics.counter_value(
+                "loadbalancer_completion_ack_regular") == 5
+            await bal.close()
+
+        asyncio.run(go())
+
+    def test_batched_ack_off_replays_serially_bit_exact(self):
+        """batchedAck=false: the frame decodes once but each ack walks
+        process_completion — final books identical to the batched path."""
+        async def go():
+            out = {}
+            for flag in (True, False):
+                bal = _mk_balancer(batched_ack=flag)
+                msgs, inv, ident = self._setup_entries(bal, 4)
+                acks = [CombinedCompletionAndResultMessage(
+                    m.transid, _activation(ident, m), inv) for m in msgs]
+                bal.process_acknowledgement_frame(
+                    AckBatchMessage(acks).serialize())
+                out[flag] = (bal.total_active_activations,
+                             len(bal.activation_slots),
+                             bal.waterfall._finished,
+                             bal.metrics.counter_value(
+                                 "loadbalancer_completion_ack_regular"))
+                await bal.close()
+            assert out[True] == out[False] == (0, 0, 4, 4)
+
+        asyncio.run(go())
+
+    def test_cross_dispatch_batch_acks_no_desync(self):
+        """Out-of-order satellite: ONE completion frame acking
+        activations from TWO different dispatch batches (interleaved,
+        reversed order) — inflight gauge and waterfall must both land at
+        zero with every vector folded."""
+        async def go():
+            bal = _mk_balancer()
+            msgs_a, inv, ident = self._setup_entries(bal, 3)
+            msgs_b, _, _ = self._setup_entries(bal, 3)
+            assert bal.total_active_activations == 6
+            mixed = [msgs_b[2], msgs_a[0], msgs_b[0], msgs_a[2],
+                     msgs_b[1], msgs_a[1]]
+            acks = [CombinedCompletionAndResultMessage(
+                m.transid, _activation(ident, m), inv) for m in mixed]
+            bal.process_acknowledgement_frame(
+                AckBatchMessage(acks).serialize())
+            assert bal.total_active_activations == 0
+            assert bal.waterfall._finished == 6
+            assert bal.waterfall.active == 0
+            await bal.close()
+
+        asyncio.run(go())
+
+    def test_partial_batch_with_evicted_entry(self):
+        """Partial satellite: one ack in the frame targets an entry that
+        was already completed (evicted) — it must count as
+        regularAfterForced without touching the live entries' books, and
+        the rest of the frame completes normally."""
+        async def go():
+            bal = _mk_balancer()
+            msgs, inv, ident = self._setup_entries(bal, 3)
+            # evict msgs[1] through the serial path first (a forced
+            # timeout), so its later batch ack is a late duplicate
+            bal.process_completion(msgs[1].activation_id, forced=True,
+                                   is_system_error=False, invoker=inv)
+            assert bal.total_active_activations == 2
+            acks = [CombinedCompletionAndResultMessage(
+                m.transid, _activation(ident, m), inv) for m in msgs]
+            bal.process_acknowledgement_frame(
+                AckBatchMessage(acks).serialize())
+            assert bal.total_active_activations == 0
+            assert not bal.activation_slots
+            assert bal.metrics.counter_value(
+                "loadbalancer_completion_ack_regular") == 2
+            assert bal.metrics.counter_value(
+                "loadbalancer_completion_ack_regularAfterForced") == 1
+            # the forced fold + the two batch folds: nothing leaked
+            assert bal.waterfall.active == 0
+            await bal.close()
+
+        asyncio.run(go())
+
+    def test_finish_many_equals_serial_finish(self):
+        wf = ActivationWaterfall(WaterfallConfig(enabled=True))
+        wf2 = ActivationWaterfall(WaterfallConfig(enabled=True))
+        aids = [f"{i:032x}" for i in range(6)]
+        t0 = time.monotonic_ns()
+        for w in (wf, wf2):
+            for i, aid in enumerate(aids):
+                w.begin(aid, t0_ns=t0)
+                w.stamp(aid, STAGE_PUBLISH_ENQUEUE, t0 + 1000 * (i + 1))
+                w.stamp(aid, STAGE_COMPLETION_ACK, t0 + 2000 * (i + 1))
+        for aid in aids:
+            wf.finish(aid)
+        assert wf2.finish_many(aids) == 6
+        assert wf._hist == wf2._hist
+        assert wf._sum_us == wf2._sum_us
+        assert wf._finished == wf2._finished
+        assert wf._total_hist == wf2._total_hist
+
+
+class TestInvokerBatchPickup:
+    def test_feed_consume_extra_backpressure(self):
+        from openwhisk_tpu.messaging.connector import MessageFeed
+
+        class _C:
+            async def peek(self, n, timeout=0.5):
+                return []
+
+            def commit(self):
+                pass
+
+            async def close(self):
+                pass
+
+        feed = MessageFeed("t", _C(), 4, lambda p: None)
+        assert feed.free_capacity == 4
+        feed.consume_extra(6)
+        assert feed.free_capacity == -2
+        for _ in range(7):
+            feed.processed()
+        assert feed.free_capacity == 5
+
+    def test_echo_fleet_roundtrip_over_batch_wire(self):
+        """End-to-end over the memory bus: a coalesced dispatch ships ONE
+        batch frame, the echo invoker decodes it once and acks in one
+        ack frame, the balancer's batch ack path completes every
+        promise. This covers bench's echo + the balancer feed wiring."""
+        import bench
+
+        async def go():
+            from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+            from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            await bal.start()
+            feeds, stop = await bench._echo_fleet(provider, 2)
+            for _ in range(80):
+                health = await bal.invoker_health()
+                if sum(h.status == HEALTHY for h in health) >= 2:
+                    break
+                await asyncio.sleep(0.25)
+            ident = _ident()
+            action = bench._bench_action("wire0", memory=128)
+            msgs = [_act_msg(ident, name="wire0", i=i) for i in range(16)]
+            promises = await asyncio.gather(*[
+                bal.publish(action, m) for m in msgs])
+            results = await asyncio.gather(*[
+                asyncio.wait_for(p, 10) for p in promises])
+            from openwhisk_tpu.messaging.coalesce import _STATS
+            wire_batches = _STATS["wire_batches"]
+            await stop()
+            await bal.close()
+            for f in feeds:
+                await f.stop()
+            return results, wire_batches
+
+        results, wire_batches = asyncio.run(go())
+        assert len(results) == 16
+        assert all(r.response.is_success for r in results)
+        assert wire_batches > 0  # the batch wire actually carried frames
+
+
+class TestFrontendSharding:
+    def test_default_builds_nothing(self):
+        p = LocalEntitlementProvider(None)
+        assert p.frontend is None
+        assert maybe_shard_frontend(p, FrontendConfig(shards=1)) is None
+
+    def test_shard_of_deterministic_and_balanced(self):
+        p = LocalEntitlementProvider(
+            None, frontend_config=FrontendConfig(shards=4))
+        try:
+            plane = p.frontend
+            assert isinstance(plane, FrontendShardPlane)
+            shards = {plane.shard_of(f"ns-{i}") for i in range(64)}
+            assert shards == {0, 1, 2, 3}
+            assert plane.shard_of("ns-7") == plane.shard_of("ns-7")
+        finally:
+            plane.close()
+
+    def test_parity_fuzz_vs_serial(self):
+        """Per-namespace decision sequences through 3 shards equal the
+        single-loop serial path's, including rejection texts."""
+        async def drive(provider, idents, seq):
+            out = []
+            for i in seq:
+                try:
+                    await provider.check(
+                        idents[i], ACTIVATE,
+                        str(idents[i].namespace.name), throttle=True)
+                    out.append((i, True, None))
+                except ThrottleRejectRequest as e:
+                    out.append((i, False, e.message))
+            return out
+
+        async def go():
+            rng = random.Random(13)
+            idents = [_ident(f"ns{k}") for k in range(10)]
+            seq = [rng.randrange(10) for _ in range(300)]
+            serial = LocalEntitlementProvider(None,
+                                              invocations_per_minute=15)
+            sharded = LocalEntitlementProvider(
+                None, invocations_per_minute=15,
+                frontend_config=FrontendConfig(shards=3))
+            try:
+                a = await drive(serial, idents, seq)
+                b = await drive(sharded, idents, seq)
+            finally:
+                await sharded.close()
+            from collections import defaultdict
+            pa, pb = defaultdict(list), defaultdict(list)
+            for i, ok, text in a:
+                pa[i].append((ok, text))
+            for i, ok, text in b:
+                pb[i].append((ok, text))
+            assert pa == pb
+            assert sharded.frontend.routed == len(seq)
+
+        asyncio.run(go())
+
+    def test_concurrency_throttle_routes_through_shards(self):
+        """The concurrency limit (backed by the balancer's counters)
+        rejects through the shard plane with the serial message."""
+        class _LB:
+            def active_activations_for(self, ns):
+                return 99
+
+        async def go():
+            p = LocalEntitlementProvider(
+                _LB(), concurrent_invocations=10,
+                frontend_config=FrontendConfig(shards=2))
+            try:
+                with pytest.raises(ThrottleRejectRequest) as ei:
+                    await p.check(_ident(), ACTIVATE, "guest",
+                                  throttle=True)
+                assert "concurrent" in str(ei.value)
+            finally:
+                await p.close()
+
+        asyncio.run(go())

@@ -97,8 +97,16 @@ def compare(old: dict, new: dict, threshold_pct: float = 20.0) -> dict:
         "backend")
     backend_new = new.get("backend") or (new.get("balancer") or {}).get(
         "backend")
-    backend_mismatch = (backend_old is not None and backend_new is not None
-                        and backend_old != backend_new)
+    # Advisory when the backends differ — OR when exactly one side is
+    # tagged: rounds before r06 only tagged the backend on CPU fallback,
+    # so an untagged old round is almost certainly a DEVICE round, and a
+    # device-vs-CPU diff must never gate (a CPU number reading as a 99%
+    # placements regression against TPU hardware is a category error,
+    # not a regression). Rounds from r06 on are always tagged, so
+    # same-backend comparisons keep their teeth.
+    backend_mismatch = (backend_old != backend_new
+                        and (backend_old is not None
+                             or backend_new is not None))
     rows = []
     regressions = []
     for label, path, direction in HEADLINES:

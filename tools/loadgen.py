@@ -352,7 +352,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                    dist: str = "poisson", n_invokers: int = 16,
                    kernel: str = "auto", waterfall: bool = True,
                    fixed_rate: Optional[float] = None, seed: int = 1,
-                   host_observatory: Optional[bool] = None) -> dict:
+                   host_observatory: Optional[bool] = None,
+                   gc_tune: bool = True) -> dict:
     """The observatory: sweep offered rate (doubling from `rate0`) to the
     max sustainable throughput, then re-measure that rate for the headline
     row + the waterfall's per-stage budget. `fixed_rate` skips the sweep
@@ -363,7 +364,16 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
     attaches its snapshot as `host` — the bench riders' measured target
     list; False forces it (and its always-on serde accounting) off for the
     overhead rider's OFF half; None (default) leaves the process-global
-    state alone."""
+    state alone.
+
+    `gc_tune` (default True, reported as `gc_tuned` in the block): after
+    the target boots, freeze the permanent heap out of the collector and
+    raise the GC thresholds (utils/hostprof.py tune_gc) — the same knob a
+    production controller gets via CONFIG_whisk_host_gc_enabled. Without
+    it, CPython's default full-heap gen-2 collections stall the loop
+    100-250 ms mid-window and the fire-lag verdict blames the generator;
+    the open_loop GC self-check still measures and reports whatever
+    pauses remain, so the tuning is a measured choice, not a blind one."""
 
     async def go() -> dict:
         from openwhisk_tpu.utils.hostprof import GLOBAL_HOST_OBSERVATORY
@@ -377,6 +387,10 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
         target = _BalancerTarget(n_invokers=n_invokers, kernel=kernel,
                                  waterfall=waterfall)
         await target.start()
+        gc_tuned = None
+        if gc_tune:
+            from openwhisk_tpu.utils.hostprof import tune_gc
+            gc_tuned = tune_gc(force=True)
         try:
             # warm long enough to actually FINISH the first-sight compiles
             # a rate's batch/release buckets trigger (ISSUE 8's coalescing
@@ -386,11 +400,30 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
             # reads exactly like saturation)
             warm_t = max(1.0, duration / 2)
 
+            ladder_done = False
+
             async def warm(rate: float, passes: int = 1) -> None:
                 # per-rate warmup: a higher rate fills bigger micro-batch
                 # buckets whose fused program jit-compiles on first sight —
                 # inside a measured window that compile stall would read as
                 # a (false) saturation verdict
+                nonlocal ladder_done
+                if not ladder_done:
+                    ladder_done = True
+                    # deterministic bucket-ladder warm, ONCE: a saturating
+                    # rate warm jumps straight to the biggest (R, B)
+                    # bucket, so the middle power-of-two shapes (a
+                    # draining tail passes through 64, 128...) would
+                    # first-sight-compile INSIDE a measured window. One
+                    # same-sweep burst per bucket touches each fused +
+                    # release-only program here instead (~6 shapes total
+                    # under the shared-bucket rule).
+                    cap = getattr(target.bal, "max_batch", 256)
+                    k = 8
+                    while k <= cap:
+                        await open_loop(target.one, [0.0] * k,
+                                        drain_timeout=30.0)
+                        k *= 2
                 for p in range(passes):
                     await _measure_step(target, rate, warm_t, dist,
                                         seed + 97 + p)
@@ -493,6 +526,7 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
             return {
                 "mode": "open_loop",
                 "dist": dist,
+                "gc_tuned": gc_tuned,
                 "sustained": bool(head["sustainable"]
                                   and (fixed_rate is not None or swept_ok)),
                 "sustained_activations_per_sec": head["throughput_per_sec"],
@@ -535,6 +569,10 @@ def main() -> None:
                     help="arm the host hot-loop observatory "
                          "(utils/hostprof.py) for the run and attach its "
                          "snapshot as `host` in the JSON line")
+    ap.add_argument("--no-gc-tune", action="store_true",
+                    help="skip the harness GC tuning (freeze + raised "
+                         "thresholds); default is tuned, reported in "
+                         "`gc_tuned`")
     args = ap.parse_args()
     try:
         out = sweep_balancer(rate0=args.rate0, duration=args.duration,
@@ -543,7 +581,8 @@ def main() -> None:
                              waterfall=not args.no_waterfall,
                              fixed_rate=args.rate,
                              host_observatory=(True if args.host_observatory
-                                               else None))
+                                               else None),
+                             gc_tune=not args.no_gc_tune)
     except Exception as e:  # noqa: BLE001 — one parseable line, always
         import traceback
         traceback.print_exc(file=sys.stderr)
