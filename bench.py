@@ -1383,6 +1383,245 @@ def _pipeline_speedup(repeats: int = 3, total: int = 1200,
         return None
 
 
+def _fleet_sweep_row(mesh, fleet: int, batch_size: int, iters: int,
+                     repeats: int, action_slots: int = 64) -> dict:
+    """One fleet size of the sharded_fleet_sweep: steady-state rate of the
+    SHARDED fused step (fleet repair pair over the mesh, previous step's
+    placements released each step — the _bench_kernel protocol), exact
+    parity vs the SINGLE-DEVICE repair kernel on the same chained steps
+    (decisions, forced bits, books, round counts), the packed entry
+    point's compile census (one compile per bucket signature, zero
+    unexpected — the balancer's watchdog contract), and the MULTICHIP
+    dryrun's heal check folded in (releasing every placement must restore
+    full capacity)."""
+    import jax
+    import jax.numpy as jnp
+
+    from openwhisk_tpu.ops.placement import (init_state,
+                                             make_fused_step,
+                                             make_fused_step_packed,
+                                             release_batch_vector,
+                                             schedule_batch_repair,
+                                             unpack_step_output)
+    from openwhisk_tpu.ops.profiler import (KernelProfiler, ProfilingConfig,
+                                            pow2_statics)
+    from openwhisk_tpu.parallel.fleet_mesh import (fleet_pair, mesh_shards,
+                                                   shard_state)
+
+    n_shards = mesh_shards(mesh)
+    batch = _rider_batch(fleet, batch_size, seed=29)
+    hidx = jnp.zeros((8,), jnp.int32)
+    hval = jnp.zeros((8,), bool)
+    hmask = jnp.zeros((8,), bool)
+    sched, rel, _ = fleet_pair(mesh, "repair")
+    fused_sh = make_fused_step(rel, sched)
+    fused_1d = _build_fused("repair")
+
+    def init(shard: bool):
+        st = init_state(fleet, [2048] * fleet, n_pad=fleet,
+                        action_slots=action_slots)
+        return shard_state(st, mesh) if shard else st
+
+    # chained-step parity: sharded vs single-device repair over the same
+    # dirtied books (2 steps: speculation + release fold both covered)
+    outs = {}
+    for tag, fused, shard in (("one", fused_1d, False), ("sh", fused_sh,
+                                                         True)):
+        st = init(shard)
+        rel_inv = jnp.zeros((batch_size,), jnp.int32)
+        rel_ok = jnp.zeros((batch_size,), bool)
+        acc = []
+        for _ in range(2):
+            st, chosen, forced, r = fused(
+                st, rel_inv, batch.conc_slot, batch.need_mb,
+                batch.max_conc, rel_ok, hidx, hval, hmask, batch)
+            acc.append((np.asarray(chosen), np.asarray(forced), int(r)))
+            rel_inv, rel_ok = jnp.clip(chosen, 0), chosen >= 0
+        outs[tag] = (acc, np.asarray(st.free_mb), np.asarray(st.conc_free))
+    parity = (
+        all(np.array_equal(a, d) and np.array_equal(b, e) and c == f
+            for (a, b, c), (d, e, f) in zip(outs["one"][0], outs["sh"][0]))
+        and np.array_equal(outs["one"][1], outs["sh"][1])
+        and np.array_equal(outs["one"][2], outs["sh"][2]))
+    rounds = [r for _, _, r in outs["sh"][0]]
+
+    # steady-state rate of the sharded step (releases chained like
+    # _bench_kernel: books stay constant, the loop runs indefinitely)
+    state0 = init(True)
+    carry = (state0, jnp.zeros((batch_size,), jnp.int32),
+             jnp.zeros((batch_size,), bool))
+
+    def step(carry):
+        st, rel_inv, rel_ok = carry
+        st, chosen, forced, _r = fused_sh(
+            st, rel_inv, batch.conc_slot, batch.need_mb, batch.max_conc,
+            rel_ok, hidx, hval, hmask, batch)
+        return (st, jnp.clip(chosen, 0), chosen >= 0), chosen
+
+    for _ in range(2):
+        carry, chosen = step(carry)
+    jax.block_until_ready(chosen)
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            carry, chosen = step(carry)
+            jax.block_until_ready(chosen)
+        rates.append(batch_size * iters / (time.perf_counter() - t0))
+
+    # the MULTICHIP dryrun, folded in: release the final outstanding
+    # placements and assert the books heal to full capacity
+    st, rel_inv, rel_ok = carry
+    st = rel(st, rel_inv, batch.conc_slot, batch.need_mb, batch.max_conc,
+             rel_ok)
+    heal = int(np.asarray(st.free_mb).sum()) == 2048 * fleet
+
+    # compile census over the PACKED entry point (the wrapper the
+    # balancer actually dispatches): repeated calls, one compile per
+    # signature, zero unexpected recompiles
+    prof = KernelProfiler(ProfilingConfig(enabled=True))
+    packed = prof.wrap("fleet_step", make_fused_step_packed(rel, sched),
+                       expected=pow2_statics)
+    req = np.stack([np.asarray(x, np.int32) for x in
+                    (batch.offset, batch.size, batch.home, batch.step_inv,
+                     batch.need_mb, batch.conc_slot, batch.max_conc,
+                     batch.rand, batch.valid)])
+    rel_np = np.zeros((5, batch_size), np.int32)
+    rel_np[3] = 1
+    health = np.zeros((3, 8), np.int32)
+    buf = jnp.asarray(np.concatenate(
+        [rel_np.ravel(), health.ravel(), req.ravel()]))
+    pstate = init(True)
+    out = None
+    for _ in range(2):
+        pstate, out = packed(pstate, buf, batch_size, 8, batch_size)
+    jax.block_until_ready(out)
+    rounds_packed = unpack_step_output(np.asarray(out))[3]
+
+    med = statistics.median(rates)
+    return {
+        "fleet": fleet,
+        "shard_rows": fleet // n_shards,
+        "rate_median": round(med, 1),
+        "rate_min": round(min(rates), 1),
+        "rate_max": round(max(rates), 1),
+        "p50_step_ms": round(batch_size / med * 1e3, 3) if med else None,
+        "rounds": rounds,
+        "rounds_packed": rounds_packed,
+        "parity_vs_single_device": parity,
+        "books_heal": heal,
+        "recompiles_unexpected": prof.compiles_unexpected,
+        "repeats": repeats,
+    }
+
+
+def _sharded_fleet_sweep_measure(fleet_sizes=(1024, 4096, 16384),
+                                 n_devices: int = 8, batch_size: int = 256,
+                                 iters: int = 6, repeats: int = 3) -> dict:
+    """In-process body of the sharded_fleet_sweep rider (ROADMAP item 2):
+    placement rate of the PRODUCTION fleet-mesh pair per fleet size,
+    sweeping 1k upward until the device runs out of memory (the HBM
+    limit) or the size list ends. On a meshless container the 8-way
+    virtual CPU mesh (--xla_force_host_platform_device_count) is the
+    honest fallback — the caller tags the line cpu_fallback. The
+    MULTICHIP_r0* standalone dryrun is folded into each row's heal
+    check; `n_devices`/`mesh_axis` ride the block so BENCH rounds stay
+    comparable to those dryruns."""
+    import jax
+
+    from openwhisk_tpu.parallel.fleet_mesh import (make_fleet_mesh,
+                                                   mesh_axis, mesh_shards)
+
+    # pow2 shard count (the invoker pads must divide evenly): a probe
+    # reporting e.g. 6 devices meshes the largest pow2 subset
+    shards = 1
+    while shards * 2 <= max(1, n_devices):
+        shards *= 2
+    mesh = make_fleet_mesh(shards)
+    out = {
+        "n_devices": mesh_shards(mesh),
+        "mesh_axis": mesh_axis(mesh),
+        "device_platform": mesh.devices.flat[0].platform,
+        "backend": jax.default_backend(),
+        "batch_size": batch_size,
+        "rows": [],
+    }
+    for fleet in fleet_sizes:
+        try:
+            out["rows"].append(_fleet_sweep_row(mesh, fleet, batch_size,
+                                                iters, repeats))
+        except Exception as e:  # noqa: BLE001 — the HBM ceiling is a
+            # RESULT, not a failure: record where the sweep stopped
+            out["hbm_limit"] = {"stopped_at_fleet": fleet,
+                                "error": f"{type(e).__name__}: {e}"[:300]}
+            break
+    out["parity_all"] = all(r.get("parity_vs_single_device")
+                            for r in out["rows"]) if out["rows"] else None
+    out["recompiles_unexpected"] = sum(
+        r.get("recompiles_unexpected", 0) for r in out["rows"])
+    return out
+
+
+def _probe_mesh(timeout_s: float = 90.0) -> tuple:
+    """Device-count probe in a SUBPROCESS with a kill timeout — the
+    dead-tunnel guard pattern (_probe_backend): a dead TPU tunnel HANGS
+    jax.devices() rather than raising, so the probe needs a kill. Returns
+    (n_devices, backend, None) or (None, None, error_string)."""
+    import os
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(len(d), jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=os.environ.copy())
+    except subprocess.TimeoutExpired:
+        return None, None, f"mesh probe hung > {timeout_s:.0f}s"
+    except Exception as e:  # noqa: BLE001 — the probe must never raise
+        return None, None, repr(e)
+    if r.returncode != 0:
+        return None, None, (r.stderr.strip().splitlines()
+                            or ["no stderr"])[-1]
+    try:
+        # LAST stdout line: device-runtime banners may precede the print
+        n, backend = r.stdout.strip().splitlines()[-1].split()
+        return int(n), backend, None
+    except (ValueError, IndexError):
+        return None, None, f"unparseable probe output: {r.stdout[-200:]!r}"
+
+
+def _sharded_fleet_sweep() -> Optional[dict]:
+    """ROADMAP item 2 rider: probe mesh availability in a subprocess
+    (dead-tunnel guard), then run the sweep in a FRESH process — on the
+    real device mesh when the probe sees >= 2 devices, else on the 8-way
+    virtual CPU mesh, honestly tagged `backend: "cpu_fallback"`. One JSON
+    block through _run_rider; advisory `compared_to` vs the newest prior
+    round."""
+    n_dev, backend, err = _probe_mesh()
+    if err is None and backend != "cpu" and (n_dev or 0) >= 2:
+        out = _subprocess_json(
+            f"bench._sharded_fleet_sweep_measure(n_devices={n_dev})",
+            "FLEETJSON", "sharded fleet sweep")
+        if out is None:  # device run died mid-sweep: fall back honestly
+            err = "device-mesh sweep subprocess failed"
+    else:
+        out = None
+    if out is None:
+        out = _cpu_subprocess_json(
+            "bench._sharded_fleet_sweep_measure()", "FLEETJSON",
+            "sharded fleet sweep (cpu mesh)", force_devices=True)
+        if out is not None:
+            out["backend"] = "cpu_fallback"
+            if err:
+                out["probe_error"] = err
+    if out is not None:
+        cmp = _compared_to("sharded_fleet_sweep", out)
+        if cmp is not None:
+            out["compared_to"] = cmp
+    return out
+
+
 def _failover_downtime(rate: float = 128.0, duration: float = 2.0,
                        n_invokers: int = 8) -> Optional[dict]:
     """ISSUE 9 rider: the HA plane's headline number. Drive an open-loop
@@ -1717,6 +1956,7 @@ def _run(args) -> Optional[dict]:
     pipeline_speedup = None
     bus_coalesce_speedup = None
     failover_downtime = None
+    sharded_fleet_sweep = None
     if not args.quick:
         # the new headline first: the open-loop observatory (sustained
         # activations/s + the per-stage budget the next PR attacks)
@@ -1735,6 +1975,10 @@ def _run(args) -> Optional[dict]:
         waterfall_overhead = timed_rider("_waterfall_overhead",
                                          _waterfall_overhead)
         repair_vs_scan = timed_rider("_repair_vs_scan", _repair_vs_scan)
+        # ROADMAP item 2: placement rate per fleet size over the
+        # ('fleet',) mesh (the MULTICHIP dryrun folded into the bench)
+        sharded_fleet_sweep = timed_rider("_sharded_fleet_sweep",
+                                          _sharded_fleet_sweep)
         pipeline_speedup = timed_rider("_pipeline_speedup",
                                        _pipeline_speedup)
         recorder_overhead = timed_rider("_flight_recorder_overhead",
@@ -1859,6 +2103,8 @@ def _run(args) -> Optional[dict]:
         out["failover_downtime"] = failover_downtime
     if repair_vs_scan is not None:
         out["repair_vs_scan"] = repair_vs_scan
+    if sharded_fleet_sweep is not None:
+        out["sharded_fleet_sweep"] = sharded_fleet_sweep
     if pipeline_speedup is not None:
         out["pipeline_speedup"] = pipeline_speedup
     if any(isinstance(r, dict) and r.get("backend") == "cpu_fallback"
@@ -1867,6 +2113,7 @@ def _run(args) -> Optional[dict]:
                      waterfall_overhead, e2e_open_loop,
                      repair_vs_scan, pipeline_speedup,
                      bus_coalesce_speedup, failover_downtime,
+                     sharded_fleet_sweep,
                      host_profiling_overhead, host_observatory)):
         # a rider lost the device mid-run and re-ran on CPU: say so at the
         # top level so trajectory readers never mistake a CPU number for a

@@ -119,6 +119,19 @@ class PlacementPathConfig:
     #: the dominant per-activation tax at high open-loop rates on the CPU
     #: twin. Off = the exact pre-coalescing eager/window policy.
     adaptive_window: bool = True
+    #: fleet_mesh: shard the invoker axis of the placement state over a
+    #: ('fleet',) device mesh (parallel/fleet_mesh.py) — the horizontal-
+    #: scale mode where fleet capacity grows with chips instead of one
+    #: device's HBM. Per-shard speculate-and-repair with a per-round
+    #: global-occupancy exchange; bit-exact with the single-device
+    #: kernels at any shard count. Default OFF = today's single-device
+    #: path, bit-exact.
+    fleet_mesh: bool = False
+    #: fleet_shards: shard count for fleet_mesh (power of two; 0 = every
+    #: visible device, rounded down to a power of two). On a meshless
+    #: container the virtual CPU devices from
+    #: --xla_force_host_platform_device_count are the honest fallback.
+    fleet_shards: int = 0
 
 
 def _next_pow2(n: int) -> int:
@@ -269,10 +282,14 @@ def _pallas_pair(placement_kernel: str):
     return auto_schedule, auto_release, "repair"
 
 
-#: one-shot calibration results: (platform, n_pad, action_slots,
-#: placement_kernel, R, H, B) -> {"rates": {...}, "winner": ...}. Module-
-#: level on purpose — a restarted balancer (or a standby promoting) with
-#: the same geometry adopts the measured choice without re-benching.
+#: one-shot calibration results: (platform, SHARD_ROWS, action_slots,
+#: placement_kernel, R, H, B) -> {"rates": {...}, "winner": ...}. Keyed by
+#: PER-SHARD rows (n_pad // n_shards), not global fleet size: a 256k-
+#: invoker fleet over 8 shards runs a 32k-row program per device, so that
+#: is the shape worth measuring — and a measurement taken single-device at
+#: 32k rows is the same program. Module-level on purpose — a restarted
+#: balancer (or a standby promoting) with the same PER-SHARD geometry
+#: adopts the measured choice without re-benching.
 _KERNEL_CALIBRATION: Dict[tuple, dict] = {}
 
 #: a backend must measure this much faster to displace the incumbent —
@@ -305,7 +322,8 @@ def _calibration_batch_buffer(n_pad: int, action_slots: int, r: int, h: int,
 def calibrate_backend_rates(n_pad: int, action_slots: int, r: int, h: int,
                             b: int, *, placement_kernel: str = "auto",
                             include_pallas: bool = True, iters: int = 4,
-                            warmup: int = 1, use_cache: bool = True) -> dict:
+                            warmup: int = 1, use_cache: bool = True,
+                            n_shards: int = 1) -> dict:
     """The kernel="auto" tiebreak: measure the fused packed step's rate for
     both device backends at ONE bucket signature and cache the result
     (one-shot per shape — `_KERNEL_CALIBRATION`). Runs wherever the caller
@@ -315,14 +333,27 @@ def calibrate_backend_rates(n_pad: int, action_slots: int, r: int, h: int,
     plain (non-admit) step is measured even when device rate-admission is
     on: the admission fold is identical XLA on both backends, so the
     relative rate is what matters. A backend that fails to build or run
-    reports a null rate and simply cannot win."""
+    reports a null rate and simply cannot win.
+
+    `n_shards`: the microbench builds and keys the PER-SHARD program —
+    `n_pad // n_shards` invoker rows, the shape one device of a
+    fleet-mesh balancer actually runs. n_shards=1 (the default) is the
+    single-device balancer, where shard_rows == n_pad."""
     platform = jax.default_backend()
-    key = (platform, n_pad, action_slots, placement_kernel, r, h, b)
+    shard_rows = max(1, n_pad // max(1, n_shards))
+    key = (platform, shard_rows, action_slots, placement_kernel, r, h, b)
     if use_cache:
         hit = _KERNEL_CALIBRATION.get(key)
         if hit is not None:
+            if (hit.get("n_pad") != n_pad
+                    or hit.get("n_shards") != n_shards):
+                # same per-shard program measured under a different
+                # topology (the key deliberately omits n_pad/n_shards):
+                # re-stamp the CALLER's view so admin planes report their
+                # own geometry, not the first measurer's
+                hit = dict(hit, n_pad=n_pad, n_shards=n_shards)
             return hit
-    buf = _calibration_batch_buffer(n_pad, action_slots, r, h, b)
+    buf = _calibration_batch_buffer(shard_rows, action_slots, r, h, b)
     rates: Dict[str, Optional[float]] = {}
     errors: Dict[str, str] = {}
     backends = ["xla"] + (["pallas"] if include_pallas else [])
@@ -331,8 +362,8 @@ def calibrate_backend_rates(n_pad: int, action_slots: int, r: int, h: int,
             sched, release, _ = (_pallas_pair if backend == "pallas"
                                  else _xla_pair)(placement_kernel)
             fn = make_fused_step_packed(release, sched)
-            state = init_state(n_pad, [1 << 20] * n_pad, n_pad=n_pad,
-                               action_slots=action_slots)
+            state = init_state(shard_rows, [1 << 20] * shard_rows,
+                               n_pad=shard_rows, action_slots=action_slots)
             out = None
             for _ in range(max(1, warmup)):
                 _st, out = fn(state, buf, r, h, b)
@@ -353,7 +384,8 @@ def calibrate_backend_rates(n_pad: int, action_slots: int, r: int, h: int,
             and live["pallas"] < live["xla"] * CALIBRATION_HYSTERESIS):
         winner = "xla"  # incumbent keeps ties-within-noise
     out = {"rates": rates, "winner": winner, "platform": platform,
-           "n_pad": n_pad, "action_slots": action_slots,
+           "n_pad": n_pad, "shard_rows": shard_rows, "n_shards": n_shards,
+           "action_slots": action_slots,
            "placement_kernel": placement_kernel, "sig": [r, h, b],
            "iters": iters}
     if errors:
@@ -363,15 +395,25 @@ def calibrate_backend_rates(n_pad: int, action_slots: int, r: int, h: int,
 
 
 def cached_backend_choice(n_pad: int, action_slots: int,
-                          placement_kernel: str) -> Optional[str]:
+                          placement_kernel: str,
+                          n_shards: int = 1) -> Optional[str]:
     """The cached calibration verdict for a geometry (largest measured
     batch bucket wins — most representative of loaded traffic), or None
-    when nothing was measured yet."""
+    when nothing was measured yet. The restart rule is PER-SHARD-SHAPE:
+    the lookup keys on `n_pad // n_shards`, so a 256k-invoker fleet over
+    8 shards calibrates the 32k-row program it actually runs and the
+    verdict transfers to whoever next needs that shape's backend choice —
+    a single-device balancer at 32k rows resolving kernel="auto", or a
+    prior fleet run / bench auto_pick row seeding it. (A fleet-mesh
+    balancer itself never swaps on the verdict: its sharded pair has no
+    xla/pallas choice, so it calibrates advisorily — see
+    _maybe_calibrate.)"""
     platform = jax.default_backend()
+    shard_rows = max(1, n_pad // max(1, n_shards))
     best = None
     # snapshot: the warm-drainer thread inserts concurrently
     for key, cal in list(_KERNEL_CALIBRATION.items()):
-        if key[:4] == (platform, n_pad, action_slots, placement_kernel):
+        if key[:4] == (platform, shard_rows, action_slots, placement_kernel):
             if best is None or cal["sig"][2] > best["sig"][2]:
                 best = cal
     return best["winner"] if best else None
@@ -483,6 +525,8 @@ class TpuBalancer(CommonLoadBalancer):
                  prewarm: Optional[bool] = None,
                  adaptive_window: Optional[bool] = None,
                  calibrate_kernel: Optional[str] = None,
+                 fleet_mesh: Optional[bool] = None,
+                 fleet_shards: Optional[int] = None,
                  profiler=None, anomaly=None, waterfall=None):
         super().__init__(messaging_provider, controller_instance, logger,
                          metrics, profiler=profiler, anomaly=anomaly,
@@ -537,7 +581,24 @@ class TpuBalancer(CommonLoadBalancer):
         self.max_batch = max_batch
         self.action_slots = action_slots
         self.max_action_slots = max(max_action_slots, action_slots)
+        #: fleet-mesh mode (CONFIG_whisk_loadBalancer_fleetMesh): build the
+        #: ('fleet',) mesh here unless the caller handed one in (the legacy
+        #: mesh= constructor path keeps working; its axis name is adopted
+        #: whatever it is). Default OFF = the single-device path, bit-exact.
+        self.fleet_mesh = (fleet_mesh if fleet_mesh is not None
+                           else path_cfg.fleet_mesh)
+        if mesh is None and self.fleet_mesh:
+            from ...parallel.fleet_mesh import make_fleet_mesh
+            shards_cfg = (fleet_shards if fleet_shards is not None
+                          else path_cfg.fleet_shards)
+            mesh = make_fleet_mesh(shards_cfg or None)
         self.mesh = mesh
+        #: mesh axis name and shard count (1 without a mesh) — the admin/
+        #: occupancy planes, journal topology records and per-shard
+        #: calibration keying all read these
+        self.fleet_axis = mesh.axis_names[0] if mesh is not None else None
+        self.n_shards = (int(np.prod(list(mesh.shape.values())))
+                         if mesh is not None else 1)
         #: opt-in bulk ACTIVATE admission ON DEVICE (ops.throttle token
         #: buckets fused into the placement step): per-namespace platform
         #: rate as a bus-boundary backstop. The HTTP front door's
@@ -548,7 +609,12 @@ class TpuBalancer(CommonLoadBalancer):
         self._ns_slots: Dict[str, int] = {}
         self._bucket_state = None
         self._t0_mono = time.monotonic()
-        self._n_pad = max(initial_pad, (mesh and np.prod(list(mesh.shape.values()))) or 1)
+        self._n_pad = max(initial_pad, self.n_shards)
+        if mesh is not None:
+            # power-of-two pad so the invoker axis always divides evenly
+            # over the (power-of-two) shard count; single-device pads keep
+            # the caller's exact value (bit-exact legacy behavior)
+            self._n_pad = _next_pow2(self._n_pad)
 
         self._registry: List[InvokerInstanceId] = []
         self._healthy: List[bool] = []
@@ -567,6 +633,11 @@ class TpuBalancer(CommonLoadBalancer):
         #: True while replay_journal re-applies records, so the re-applied
         #: mutations don't journal themselves again
         self._journal_mute = False
+        #: a fleet-mesh writer stamps ONE `mesh` topology record ahead of
+        #: its first append (per process / per promotion), so a replayer
+        #: on a different device count cold-starts with a logged reason
+        #: instead of silently mis-sharding
+        self._journal_mesh_stamped = False
         #: host numpy copy of free_mb from the last readback/state install —
         #: occupancy() serves from this, never the live device buffer.
         #: Installs are sequence-guarded: readback worker threads finish
@@ -643,6 +714,10 @@ class TpuBalancer(CommonLoadBalancer):
         # same 1 Hz cadence
         if self.journal is not None:
             self.journal.export_gauges(self.metrics)
+        # fleet-mesh visibility: shard count + per-shard occupancy from
+        # the cached books (host-side only)
+        if self.mesh is not None:
+            self._export_shard_gauges()
 
     # -- device state ------------------------------------------------------
     def _resolve_kernel(self) -> str:
@@ -652,7 +727,7 @@ class TpuBalancer(CommonLoadBalancer):
         # balancer (or a promoted standby) with the same geometry adopts
         # the calibration verdict immediately
         cal = cached_backend_choice(self._n_pad, self.action_slots,
-                                    self.placement_kernel)
+                                    self.placement_kernel, self.n_shards)
         if cal is not None:
             self._kernel_chosen_by = "calibration"
             return cal
@@ -672,18 +747,19 @@ class TpuBalancer(CommonLoadBalancer):
             "sharded" if self.mesh is not None else self._resolve_kernel())
         installed = False
         if self.mesh is not None:
-            from ...parallel.sharded_state import (make_sharded_release,
-                                                   make_sharded_schedule,
-                                                   shard_state)
-            self.state = shard_state(state, self.mesh)
-            self._sched_fn = make_sharded_schedule(self.mesh)
-            self._release_fn = make_sharded_release(self.mesh)
-            self.placement_kernel_resolved = "scan"
+            from ...parallel.fleet_mesh import fleet_pair
+            from ...parallel.sharded_state import shard_state
+            self.state = shard_state(state, self.mesh, axis=self.fleet_axis)
+            # the full placementKernel knob works on the mesh: scan keeps
+            # the prototype sharded scan, repair installs the per-shard
+            # speculate-and-repair kernel with the global-occupancy
+            # exchange, auto is the shared per-bucket static hybrid
+            (self._sched_fn, self._release_fn,
+             self.placement_kernel_resolved) = fleet_pair(
+                self.mesh, self.placement_kernel,
+                repair_min_batch=self.REPAIR_MIN_BATCH,
+                axis=self.fleet_axis)
             installed = True
-            if self.placement_kernel == "repair" and self.logger:
-                self.logger.warn(
-                    None, "placement_kernel=repair has no sharded variant; "
-                    "the mesh schedule keeps its scan kernel")
         elif self.kernel_resolved == "pallas":
             plan = self._pallas_plan()
             if plan is not None:
@@ -788,10 +864,12 @@ class TpuBalancer(CommonLoadBalancer):
         Buckets grow by doubling, so (2R, H, B) and (R, H, 2B) keep the
         compiled set one step ahead of traffic growth; already-warmed
         signatures de-dup in _warm_sigs (reset when the fns rebuild).
-        Skipped on a mesh: sharded inputs would key a different cache.
+        On a fleet mesh the warm dummies are sharded like the live state
+        (same NamedSharding → same jit cache key), so the mesh pays the
+        same zero in-dispatch compile stalls as the single-device path.
         `prewarm=False` disables the whole plane (legacy compile-on-demand
         behavior)."""
-        if self.mesh is not None or not self.prewarm:
+        if not self.prewarm:
             return
         self._warm_sigs.add((r, h, b))  # the live call just compiled it
         cand = []
@@ -838,12 +916,17 @@ class TpuBalancer(CommonLoadBalancer):
         # all-zero dummies: valid masks are 0, so nothing places or
         # releases — only the compile (keyed on shapes + statics) matters.
         # Donation consumes the dummies, nothing else; each warmed entry
-        # point gets its own.
+        # point gets its own. On a mesh the dummy is sharded exactly like
+        # the live state so the warm compile keys the live cache entry.
         def dummy_state():
-            return PlacementState(
+            st = PlacementState(
                 jnp.zeros((self._n_pad,), jnp.int32),
                 jnp.zeros((self._n_pad, self.action_slots), jnp.int32),
                 jnp.zeros((self._n_pad,), bool))
+            if self.mesh is not None:
+                from ...parallel.sharded_state import shard_state
+                st = shard_state(st, self.mesh, axis=self.fleet_axis)
+            return st
 
         if rate_on:
             buckets = init_buckets(self.RATE_NS_BUCKETS,
@@ -883,11 +966,16 @@ class TpuBalancer(CommonLoadBalancer):
             return None
 
     def _calibration_enabled(self) -> bool:
-        """Calibration requires an auto kernel knob, a single-device
-        balancer, and a backend where the pallas kernels actually compile
-        (a TPU) — unless "force" overrides for the CPU-twin tests/bench."""
-        if (self.kernel != "auto" or self.mesh is not None
-                or self.calibrate_kernel == "off"):
+        """Calibration requires an auto kernel knob and a backend where
+        the pallas kernels actually compile (a TPU) — unless "force"
+        overrides for the CPU-twin tests/bench. A FLEET-MESH balancer
+        calibrates too — the microbench measures the single-device fused
+        step at the PER-SHARD shape, the compute each of its devices
+        runs — but only advisorily (see _maybe_calibrate): the sharded
+        pair is not swappable, so the measurement populates the shared
+        per-shard cache and the admin plane without ever moving the
+        running kernels."""
+        if self.kernel != "auto" or self.calibrate_kernel == "off":
             return False
         if self.calibrate_kernel == "force":
             return True
@@ -903,10 +991,13 @@ class TpuBalancer(CommonLoadBalancer):
             return None
         from ...ops.placement_pallas import (HAS_PALLAS, fits_vmem,
                                              fits_vmem_repair)
+        # the fit (like the microbench itself) is judged at the PER-SHARD
+        # shape — the rows one device actually holds
+        rows = max(1, self._n_pad // self.n_shards)
         pallas_ok = HAS_PALLAS and (
-            fits_vmem_repair(self._n_pad, self.action_slots, self.max_batch)
+            fits_vmem_repair(rows, self.action_slots, self.max_batch)
             if self.placement_kernel != "scan"
-            else fits_vmem(self._n_pad, self.action_slots))
+            else fits_vmem(rows, self.action_slots))
         if not pallas_ok:
             # one-sided measurement cannot pick a winner: an xla-only
             # bench would "win" by default and demote a statically-chosen
@@ -916,15 +1007,23 @@ class TpuBalancer(CommonLoadBalancer):
         cal = calibrate_backend_rates(
             self._n_pad, self.action_slots, r, h, b,
             placement_kernel=self.placement_kernel,
-            iters=2 if self.calibrate_kernel == "force" else 5)
+            iters=2 if self.calibrate_kernel == "force" else 5,
+            n_shards=self.n_shards)
         self._calibration = cal
+        if self.mesh is not None:
+            # ADVISORY on a fleet mesh: the sharded pair has no backend
+            # swap, so the per-shard measurement only feeds the shared
+            # cache (a restarted balancer whose shard shape matches — at
+            # any topology — adopts it) and /admin/profile/kernel
+            return None
         # the SWAP decision follows the largest measured bucket for this
         # geometry (cached_backend_choice — the same rule a restarted
         # balancer applies at construction), not this signature's own row:
         # a small bucket's noise verdict must not ping-pong the backend,
         # since every swap flushes the warm jit caches
         winner = (cached_backend_choice(self._n_pad, self.action_slots,
-                                        self.placement_kernel)
+                                        self.placement_kernel,
+                                        self.n_shards)
                   or cal["winner"])
         if winner == self.kernel_resolved:
             self._kernel_chosen_by = "calibration"
@@ -1177,7 +1276,8 @@ class TpuBalancer(CommonLoadBalancer):
         old_free = np.asarray(st.free_mb)
         old_conc = np.asarray(st.conc_free)
         old_health = np.asarray(st.health)
-        self.profiler.expect("fleet_growth")
+        self.profiler.expect("reshard" if self.mesh is not None
+                             else "fleet_growth")
         n_old = old_free.shape[0]
         free = np.zeros((new_pad,), np.int32)
         free[:n_old] = old_free
@@ -1213,10 +1313,15 @@ class TpuBalancer(CommonLoadBalancer):
 
     def _install_state(self, state: PlacementState) -> None:
         """Adopt new-shape device arrays: shard onto the mesh (if any) and
-        drop pallas if the shapes outgrew its VMEM budget."""
+        drop pallas if the shapes outgrew its VMEM budget. On a mesh this
+        IS a reshard event — the new-shape shard_map programs compile
+        under an expect window (the caller's growth/restore window, plus
+        this explicit reshard stamp) so the recompile watchdog stays
+        quiet through cluster grow/resize."""
         if self.mesh is not None:
             from ...parallel.sharded_state import shard_state
-            state = shard_state(state, self.mesh)
+            self.profiler.expect("reshard")
+            state = shard_state(state, self.mesh, axis=self.fleet_axis)
         self.state = state
         self._set_books_now(np.asarray(state.free_mb))
         if getattr(self, "kernel_resolved", self.kernel) == "pallas":
@@ -1272,7 +1377,8 @@ class TpuBalancer(CommonLoadBalancer):
         (ref updateCluster :561-584)."""
         if cluster_size != self._cluster_size:
             self._cluster_size = cluster_size
-            self.profiler.expect("cluster_resize")
+            self.profiler.expect("reshard" if self.mesh is not None
+                                 else "cluster_resize")
             self._init_device_state()
             self._recompute_partitions()  # capacity shares changed
             if self._journal_live():
@@ -1288,7 +1394,7 @@ class TpuBalancer(CommonLoadBalancer):
         self.supervision.start()
         # warm the first-traffic bucket signature while the fleet is still
         # registering, so the opening micro-batches skip the cold compile
-        if self.mesh is None and self.prewarm and \
+        if self.prewarm and \
                 (8, self.HEALTH_BATCH, 8) not in self._warm_sigs:
             self._spawn_warm([(8, self.HEALTH_BATCH, 8)])
 
@@ -1499,7 +1605,49 @@ class TpuBalancer(CommonLoadBalancer):
                        healthy[i] if i < len(healthy) else False,
                        cap, f, cap - f)
 
-        return occupancy_json(self.kernel_resolved, rows())
+        out = occupancy_json(self.kernel_resolved, rows())
+        if self.mesh is not None:
+            # per-shard books aggregated from the SAME cached vector —
+            # still zero device syncs on the API path
+            out["mesh"] = {"n_shards": self.n_shards,
+                           "axis": self.fleet_axis}
+            out["shards"] = self._shard_occupancy(free, caps)
+        return out
+
+    def _shard_occupancy(self, free, caps) -> List[dict]:
+        """Per-shard occupancy rows from host-cached books. Shard s owns
+        invoker rows [s*k, (s+1)*k) with k = n_pad / n_shards (the
+        NamedSharding block layout); padding rows carry zero capacity and
+        zero free, so they drop out of the sums."""
+        rows_per = max(1, self._n_pad // max(1, self.n_shards))
+        n_reg = len(caps)
+        out = []
+        for s in range(self.n_shards):
+            lo, hi = s * rows_per, (s + 1) * rows_per
+            reg_hi = min(hi, n_reg)
+            cap = int(caps[lo:reg_hi].sum()) if lo < n_reg else 0
+            f = int(free[lo:min(hi, len(free))].sum()) \
+                if lo < len(free) else cap
+            used = cap - f
+            out.append({"shard": s,
+                        "invokers": max(0, reg_hi - lo),
+                        "capacity_mb": cap, "used_mb": used,
+                        "occupancy": (round(used / cap, 4) if cap
+                                      else 0.0)})
+        return out
+
+    def _export_shard_gauges(self) -> None:
+        """`loadbalancer_fleet_shards` + per-shard occupancy ratios from
+        the cached books — host numpy only, never a device sync (rides
+        the 1 Hz supervision tick)."""
+        self.metrics.gauge("loadbalancer_fleet_shards", self.n_shards)
+        free = self._books_cache
+        if free is None:
+            return
+        for row in self._shard_occupancy(free, self._caps_mb):
+            self.metrics.gauge("loadbalancer_shard_occupancy_ratio",
+                               row["occupancy"],
+                               tags={"shard": str(row["shard"])})
 
     def kernel_profile(self) -> dict:
         """The profiling-plane payload, labeled with the kernel actually
@@ -1509,6 +1657,9 @@ class TpuBalancer(CommonLoadBalancer):
         out["placement_kernel"] = getattr(self, "placement_kernel_resolved",
                                           self.placement_kernel)
         out["kernel_chosen_by"] = getattr(self, "_kernel_chosen_by", "static")
+        if self.mesh is not None:
+            out["mesh"] = {"n_shards": self.n_shards,
+                           "axis": self.fleet_axis}
         if self._calibration is not None:
             out["calibration"] = self._calibration
         return out
@@ -1534,6 +1685,14 @@ class TpuBalancer(CommonLoadBalancer):
         snapshot's `journal_seq` is exactly consistent with its books."""
         if not self._journal_live():
             return 0
+        if (self.mesh is not None and not self._journal_mesh_stamped
+                and rec.get("t") != "mesh"):
+            # topology header: ONE `mesh` record ahead of this writer's
+            # first append (rides alongside `reg`/`cluster`), so replay
+            # can refuse a different device count with a logged reason
+            self._journal_mesh_stamped = True
+            from ...parallel.fleet_mesh import mesh_topology
+            self._journal_append({"t": "mesh", **mesh_topology(self.mesh)})
         self._journal_seq += 1
         rec["seq"] = self._journal_seq
         if self.fence_epoch is not None:
@@ -1563,18 +1722,21 @@ class TpuBalancer(CommonLoadBalancer):
         Batches journaled at dispatch but crashed before readback replay
         with their full request set (conservative over-hold: those
         placements were computed on the dead device; self-heal via forced
-        timeouts reclaims them, exactly the checkpoint posture)."""
+        timeouts reclaims them, exactly the checkpoint posture).
+
+        Mesh topology: a fleet-mesh writer stamps `mesh` records and a
+        shard count (`S`) on every batch record. Replay proceeds only on
+        a MATCHING topology (a promoted standby with the same device
+        count reshards at restore and replays the tail bit-exactly);
+        any mismatch — journal written at a different shard count, or a
+        single-device journal replayed on a mesh (and vice versa) —
+        COLD-STARTS with a logged reason instead of silently
+        mis-sharding (`skipped: "mesh_topology"`)."""
         log = logger or self.logger
         if from_seq is not None:
             self._journal_seq = int(from_seq)
         stats = {"replayed": 0, "batches": 0, "parity_mismatches": 0,
                  "from_seq": self._journal_seq}
-        if self.mesh is not None:
-            if log:
-                log.warn(None, "journal replay is not supported on a "
-                               "sharded mesh balancer; skipping", "TpuBalancer")
-            stats["skipped"] = "mesh"
-            return stats
         self.profiler.expect("snapshot_restore")
         recs = [r for r in records]
         # stale-epoch filter: a demoted active's already-popped write batch
@@ -1612,7 +1774,15 @@ class TpuBalancer(CommonLoadBalancer):
                     continue
                 if seq <= self._journal_seq:
                     continue
-                if t == "batch":
+                if t in ("batch", "mesh"):
+                    got = int(rec.get("S" if t == "batch" else "n_shards",
+                                      1))
+                    if got != self.n_shards:
+                        return self._topology_coldstart(stats, recs, got,
+                                                        log)
+                if t == "mesh":
+                    pass  # topology verified above; nothing to re-apply
+                elif t == "batch":
                     self._replay_batch(rec, acks.get(seq), replay_step,
                                        stats)
                 elif t == "fold":
@@ -1643,6 +1813,29 @@ class TpuBalancer(CommonLoadBalancer):
                            f"{stats['parity_mismatches']} decisions "
                            "differently than the recorded readback (kernel "
                            "knobs changed across the restart?)", "TpuBalancer")
+        return stats
+
+    def _topology_coldstart(self, stats: dict, recs: list, got: int,
+                            log) -> dict:
+        """A journal tail written at a different mesh topology cannot be
+        replayed here (the packed records are deterministic only through
+        the SAME sharded kernels): cold-start — fresh full-capacity books
+        over the restored registry; leaked in-flight holds self-heal via
+        forced timeouts, exactly the pruned-tail posture — with a logged
+        reason. Every seq in the tail is still claimed so a promoted
+        active never reuses one."""
+        if log:
+            log.warn(None, f"placement journal tail was written at {got} "
+                           f"fleet shard(s) but this balancer runs "
+                           f"{self.n_shards}; cold-starting instead of "
+                           f"mis-sharding the replay", "TpuBalancer")
+        stats["skipped"] = "mesh_topology"
+        stats["journal_shards"] = got
+        stats["balancer_shards"] = self.n_shards
+        self._journal_seq = max(
+            [self._journal_seq] + [int(r.get("seq", 0)) for r in recs])
+        self._init_device_state()
+        stats["last_seq"] = self._journal_seq
         return stats
 
     def _replay_batch(self, rec: dict, ack: Optional[dict], replay_step,
@@ -1716,6 +1909,7 @@ class TpuBalancer(CommonLoadBalancer):
             "state": self._materialize_state(),
             "journal_seq": self._journal_seq,
             "n_pad": self._n_pad,
+            "fleet_shards": self.n_shards,
             "cluster_size": self._cluster_size,
             "action_slots": self.action_slots,
             "registry": [inv.to_json() for inv in self._registry],
@@ -1743,11 +1937,27 @@ class TpuBalancer(CommonLoadBalancer):
 
     def restore(self, snap: dict) -> None:
         self.profiler.expect("snapshot_restore")
+        # the snapshot's books are GLOBAL (topology-independent): restoring
+        # them onto a different shard count is a deterministic reshard —
+        # _install_state re-places every row on this balancer's own mesh.
+        # Said out loud because the JOURNAL tail is not topology-portable
+        # (replay_journal cold-starts on a mismatch).
+        snap_shards = int(snap.get("fleet_shards", 1))
+        if snap_shards != self.n_shards and self.logger:
+            self.logger.info(
+                None, f"snapshot was taken at {snap_shards} fleet "
+                f"shard(s); resharding deterministically onto "
+                f"{self.n_shards}", "TpuBalancer")
         # the snapshot's books already hold every journaled mutation up to
         # this seq: replay_journal resumes from here (older snapshots carry
         # no seq — a full-history journal replays from 0)
         self._journal_seq = int(snap.get("journal_seq", 0))
         self._n_pad = int(snap["n_pad"])
+        if self.mesh is not None and self._n_pad % self.n_shards:
+            # a single-device snapshot may carry a pad the mesh cannot
+            # divide: round up (extra rows are unhealthy zero-capacity
+            # padding, exactly like growth padding)
+            self._n_pad = _next_pow2(max(self._n_pad, self.n_shards))
         self._cluster_size = int(snap["cluster_size"])
         # older snapshots predate the growable slot axis
         self.action_slots = int(snap.get("action_slots", self.action_slots))
@@ -1755,6 +1965,9 @@ class TpuBalancer(CommonLoadBalancer):
                           for j in snap["registry"]]
         self._healthy = [bool(h) for h in snap["healthy"]]
         free = np.asarray(snap["free_mb"], np.int32)
+        if len(free) < self._n_pad:  # pad rounded up above
+            free = np.concatenate(
+                [free, np.zeros((self._n_pad - len(free),), np.int32)])
         conc = np.zeros((self._n_pad, self.action_slots), np.int32)
         for i, j, v in snap.get("conc_nonzero", []):
             conc[i, j] = v
@@ -2037,6 +2250,8 @@ class TpuBalancer(CommonLoadBalancer):
                 "queue_depth": b + len(self._pending),
                 "oldest_age_ms": round((t0 - batch[0][3]) * 1e3, 3),
             })
+            if self.mesh is not None:
+                rec.digest["shards"] = self.n_shards
             tid = next((e[6] for e in batch if e[6]), None)
             if tid is not None:
                 # the record carries a trace: the phase histogram's bucket
@@ -2096,11 +2311,17 @@ class TpuBalancer(CommonLoadBalancer):
         # order (readback appends a matching `ack` with the decisions)
         jseq = 0
         if self._journal_live():
-            jseq = self._journal_append({
+            jrec = {
                 "t": "batch", "R": int(rel_np.shape[1]),
                 "H": int(health_np.shape[1]), "B": bp,
                 "rows": rows, "b": b, "buf": encode_array(buf),
-                "aids": [e[4] for e in batch]})
+                "aids": [e[4] for e in batch]}
+            if self.mesh is not None:
+                # shard count travels on EVERY batch record (the one-shot
+                # `mesh` header can be pruned away with its snapshot):
+                # replay refuses a topology mismatch per batch
+                jrec["S"] = self.n_shards
+            jseq = self._journal_append(jrec)
         # compile-ahead: warm the successor bucket shapes off-loop before
         # queue growth needs them in a live dispatch
         self._prewarm_buckets(rel_np.shape[1], health_np.shape[1], bp)

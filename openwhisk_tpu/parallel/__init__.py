@@ -11,6 +11,12 @@ collectives ride ICI, host code never touches per-invoker state (SURVEY
 """
 from .sharded_state import (make_mesh, make_sharded_schedule,
                             make_sharded_release, shard_state)
+from .fleet_mesh import (FLEET_AXIS, fleet_pair, make_fleet_mesh,
+                         make_fleet_release_vector,
+                         make_fleet_repair_schedule, mesh_axis, mesh_shards,
+                         mesh_topology)
 
 __all__ = ["make_mesh", "make_sharded_schedule", "make_sharded_release",
-           "shard_state"]
+           "shard_state", "FLEET_AXIS", "make_fleet_mesh", "fleet_pair",
+           "make_fleet_repair_schedule", "make_fleet_release_vector",
+           "mesh_axis", "mesh_shards", "mesh_topology"]

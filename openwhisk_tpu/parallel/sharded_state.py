@@ -60,9 +60,12 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "inv") -> Mesh:
     return Mesh(devices[:want], (axis,))
 
 
-def shard_state(state: PlacementState, mesh: Mesh, axis: str = "inv"
+def shard_state(state: PlacementState, mesh: Mesh, axis: Optional[str] = None
                 ) -> PlacementState:
-    """Place the state arrays with the invoker axis sharded over the mesh."""
+    """Place the state arrays with the invoker axis sharded over the mesh.
+    `axis=None` infers the mesh's (single) axis name, so the same call
+    works for the prototype "inv" meshes and the production "fleet" ones."""
+    axis = axis or mesh.axis_names[0]
     n = state.free_mb.shape[0]
     assert n % mesh.shape[axis] == 0, \
         f"invoker padding {n} must divide evenly over {mesh.shape[axis]} shards"

@@ -64,16 +64,46 @@ def _pallas_probe():
     return _pallas_probe_result
 
 
+# ---------------------------------------------------------------------------
+# `mesh` marker guard: the fleet-mesh suites need >= 8 devices (the virtual
+# CPU mesh the env vars above request). An environment that cannot provide
+# them — e.g. jax honoring a pre-set smaller XLA_FLAGS — SKIPS with a
+# logged reason instead of failing on shard_state's divisibility assert.
+# ---------------------------------------------------------------------------
+MESH_TEST_DEVICES = 8
+_mesh_probe_result = None
+
+
+def _mesh_probe(want: int = MESH_TEST_DEVICES):
+    """(ok, reason) — cached device-count probe for mesh-marked tests."""
+    global _mesh_probe_result
+    if _mesh_probe_result is not None:
+        return _mesh_probe_result
+    try:
+        import jax as _jax
+        n = len(_jax.devices())
+        if n < want:
+            _mesh_probe_result = (
+                False, f"need {want} devices for the virtual fleet mesh, "
+                       f"have {n}")
+        else:
+            _mesh_probe_result = (True, "")
+    except Exception as e:  # noqa: BLE001 — any breakage means "skip"
+        _mesh_probe_result = (False, f"jax devices unavailable: {e!r}")
+    return _mesh_probe_result
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest
 
-    if not any("pallas" in item.keywords for item in items):
-        return
-    ok, reason = _pallas_probe()
-    if ok:
-        return
-    print(f"# skipping pallas-marked tests: {reason}", file=sys.stderr)
-    skip = pytest.mark.skip(reason=f"pallas unavailable: {reason}")
-    for item in items:
-        if "pallas" in item.keywords:
-            item.add_marker(skip)
+    for marker, probe in (("pallas", _pallas_probe), ("mesh", _mesh_probe)):
+        if not any(marker in item.keywords for item in items):
+            continue
+        ok, reason = probe()
+        if ok:
+            continue
+        print(f"# skipping {marker}-marked tests: {reason}", file=sys.stderr)
+        skip = pytest.mark.skip(reason=f"{marker} unavailable: {reason}")
+        for item in items:
+            if marker in item.keywords:
+                item.add_marker(skip)

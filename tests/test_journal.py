@@ -598,3 +598,139 @@ class TestStandbyAndFencing:
             ActivationId.generate(), ControllerInstanceId("0"), False, {},
             fence_epoch=7)
         assert ActivationMessage.parse(fenced.serialize()).fence_epoch == 7
+
+
+@pytest.mark.mesh
+class TestMeshTopologyReplay:
+    """ISSUE 13 satellite: the journal records the mesh topology (a
+    `mesh` record alongside reg/cluster, plus a shard count on every
+    batch record). A promoted standby on the SAME topology reshards at
+    restore and replays the tail bit-exactly; replay on a DIFFERENT
+    device count cold-starts with a logged reason instead of silently
+    mis-sharding."""
+
+    N_SHARDS = 8
+
+    def _mesh_balancer(self, provider, instance="0", **kw):
+        kw.setdefault("prewarm", False)
+        kw.setdefault("initial_pad", 16)
+        kw.setdefault("max_batch", 32)
+        return _balancer(provider, instance, fleet_mesh=True,
+                         fleet_shards=self.N_SHARDS, **kw)
+
+    async def _journal_some_traffic(self, bal, n_invokers=12, total=24):
+        from openwhisk_tpu.core.entity import InvokerInstanceId, MB
+        from openwhisk_tpu.controller.loadbalancer import HEALTHY
+
+        async def fake_send(msg, invoker):
+            return None
+
+        bal.send_activation_to_invoker = fake_send
+        for i in range(n_invokers):
+            bal._status_change(InvokerInstanceId(i, user_memory=MB(2048)),
+                               HEALTHY)
+        ident = Identity.generate("guest")
+        actions = [make_action(f"mt{i}", memory=[128, 256][i % 2])
+                   for i in range(4)]
+        await asyncio.gather(*[
+            bal.publish(actions[i % 4], make_msg(actions[i % 4], ident))
+            for i in range(total)])
+        assert bal.journal.flush()
+
+    def test_mesh_record_stamped_and_same_topology_replays_bit_exact(
+            self, tmp_path):
+        jdir = str(tmp_path / "wal")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = self._mesh_balancer(provider)
+            bal.attach_journal(PlacementJournal(jdir))
+            await self._journal_some_traffic(bal)
+            live_free = np.asarray(bal.state.free_mb)
+            live_conc = np.asarray(bal.state.conc_free)
+            await bal.close()
+
+            recs = list(PlacementJournal(jdir).records(0))
+            # the topology header precedes the first record, and every
+            # batch record carries the shard count
+            assert recs[0]["t"] == "mesh"
+            assert recs[0]["n_shards"] == self.N_SHARDS
+            assert recs[0]["axis"] == "fleet"
+            assert all(r.get("S") == self.N_SHARDS
+                       for r in recs if r.get("t") == "batch")
+
+            # a promoted standby with the SAME device count replays the
+            # full history through the sharded kernels, bit-exactly
+            cold = self._mesh_balancer(provider, "1")
+            stats = cold.replay_journal(PlacementJournal(jdir).records(0))
+            same = (np.array_equal(np.asarray(cold.state.free_mb),
+                                   live_free)
+                    and np.array_equal(np.asarray(cold.state.conc_free),
+                                       live_conc))
+            await cold.close()
+            return stats, same
+
+        stats, same = asyncio.run(go())
+        assert "skipped" not in stats
+        assert stats["batches"] >= 1
+        assert stats["parity_mismatches"] == 0
+        assert same, "same-topology mesh replay must be bit-exact"
+
+    def test_replay_on_different_device_count_cold_starts(self, tmp_path):
+        jdir = str(tmp_path / "wal")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = self._mesh_balancer(provider)
+            bal.attach_journal(PlacementJournal(jdir))
+            await self._journal_some_traffic(bal)
+            last = bal._journal_seq
+            await bal.close()
+
+            # a single-device balancer (n_shards=1 != 8) must refuse the
+            # tail: cold start, logged reason, every seq still claimed
+            single = _balancer(provider, "1", prewarm=False,
+                               initial_pad=16, max_batch=32)
+            stats = single.replay_journal(PlacementJournal(jdir).records(0))
+            full = np.asarray(single.state.free_mb)
+            await single.close()
+            return stats, full, last
+
+        stats, free, last = asyncio.run(go())
+        assert stats["skipped"] == "mesh_topology"
+        assert stats["journal_shards"] == self.N_SHARDS
+        assert stats["balancer_shards"] == 1
+        assert stats["last_seq"] >= last, \
+            "a cold start must still claim the tail's seqs"
+        # cold start: NO mis-sharded replay landed — the books are the
+        # re-initialized state (the fleet re-registers from live pings,
+        # exactly the pruned-tail-without-snapshot posture)
+        assert int(free.sum()) == 0 and len(free) == 16
+
+    def test_single_device_tail_refused_on_mesh(self, tmp_path):
+        """The reverse direction: a journal written by a single-device
+        balancer (no mesh records, no S on batches) must not replay on a
+        mesh balancer — its batch records imply n_shards=1."""
+        jdir = str(tmp_path / "wal")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider, prewarm=False, initial_pad=16,
+                            max_batch=32)
+            bal.attach_journal(PlacementJournal(jdir))
+            await self._journal_some_traffic(bal)
+            await bal.close()
+            recs = list(PlacementJournal(jdir).records(0))
+            assert not any(r.get("t") == "mesh" for r in recs), \
+                "single-device journals stay byte-compatible (no mesh recs)"
+            assert not any("S" in r for r in recs if r.get("t") == "batch")
+
+            meshy = self._mesh_balancer(provider, "1")
+            stats = meshy.replay_journal(PlacementJournal(jdir).records(0))
+            await meshy.close()
+            return stats
+
+        stats = asyncio.run(go())
+        assert stats["skipped"] == "mesh_topology"
+        assert stats["journal_shards"] == 1
+        assert stats["balancer_shards"] == self.N_SHARDS
