@@ -770,6 +770,26 @@ def _compared_to(rider_key: str, new_block: dict,
         return None
 
 
+def _e2e_fleet_mesh_measure(rate0: float = 32.0, duration: float = 2.0,
+                            max_doublings: int = 6) -> Optional[dict]:
+    """The fleet-mesh comparison point (ROADMAP item 2c): the SAME
+    coordinated-omission-correct open-loop sweep, against a balancer in
+    fleet-mesh mode (invoker state sharded over the ('fleet',) mesh).
+    Runs in a CPU-pinned 8-virtual-device subprocess — the honest
+    virtual mesh, same posture as the sharded_fleet_sweep rider; a clean
+    DEVICE round of this row stays on the ROADMAP item 2 list."""
+    from tools.loadgen import sweep_balancer
+    row = sweep_balancer(rate0=rate0, duration=duration,
+                         max_doublings=max_doublings, fleet_mesh=True)
+    keep = {k: row.get(k) for k in (
+        "sustained", "sustained_activations_per_sec",
+        "sustained_offered_rate", "p50_ms", "p99_ms", "fleet_shards",
+        "gc_tuned")}
+    keep["mode"] = "open_loop"
+    keep["fleet_mesh"] = True
+    return keep
+
+
 def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
                    max_doublings: int = 9) -> Optional[dict]:
     """The ISSUE 7 headline rider: open-loop offered-rate sweep against the
@@ -792,6 +812,15 @@ def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
         if out is not None:
             out["backend"] = "cpu_fallback"
     if out is not None:
+        # fleet-mesh comparison row (ROADMAP item 2c): same open-loop
+        # judge, sharded balancer, 8-way virtual CPU mesh (tagged cpu —
+        # never mistakable for a device number)
+        mesh = _cpu_subprocess_json("bench._e2e_fleet_mesh_measure()",
+                                    "RIDERJSON", "e2e fleet-mesh point",
+                                    force_devices=True)
+        if mesh is not None:
+            mesh["backend"] = "cpu"
+            out["fleet_mesh_point"] = mesh
         cmp_block = _compared_to("e2e_open_loop", out)
         if cmp_block is not None:
             out["compared_to"] = cmp_block

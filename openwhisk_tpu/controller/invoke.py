@@ -69,6 +69,13 @@ class ActionInvoker:
         self.load_balancer = load_balancer
         self.controller = controller_instance
         self.logger = logger
+        # batch-shaped publish (ISSUE 14): when the balancer runs the
+        # batched SPI, concurrent invokes in one event-loop sweep hand
+        # the balancer ONE publish_many batch instead of N publish
+        # coroutines. None (knob off / CPU balancers without the SPI)
+        # keeps the serial publish call bit-exact.
+        from .loadbalancer.base import maybe_batch_publish
+        self._publish_batcher = maybe_batch_publish(load_balancer)
 
     async def invoke(self, identity: Identity, action: WhiskAction,
                      package_params: Parameters, payload: Optional[Dict[str, Any]],
@@ -108,7 +115,10 @@ class ActionInvoker:
                                trace_id=trace_id_of(msg.trace_context))
         try:
             try:
-                promise = await self.load_balancer.publish(action, msg)
+                if self._publish_batcher is not None:
+                    promise = await self._publish_batcher.publish(action, msg)
+                else:
+                    promise = await self.load_balancer.publish(action, msg)
             except (Exception, asyncio.CancelledError):
                 # rejected before entering the pipeline (throttle, no
                 # invokers) or the client went away mid-publish

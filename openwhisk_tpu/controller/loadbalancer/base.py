@@ -133,6 +133,20 @@ class LoadBalancer:
         WhiskActivation (completion) or raising ActiveAckTimeout."""
         raise NotImplementedError
 
+    def publish_many(self, pairs: List[tuple]) -> List[asyncio.Future]:
+        """The batch-shaped publish SPI (ISSUE 14): schedule a whole
+        admission batch of `(action, msg)` pairs in one call. Returns one
+        future per pair, each resolving to what `publish` would have
+        returned (the completion promise) or raising what `publish`
+        would have raised (throttle/no-invoker/shutdown), so callers
+        holding a batch stop paying one publish coroutine per
+        activation. This default keeps serial semantics — one `publish`
+        task per pair — for balancers without a batched path; the
+        TpuBalancer overrides it with the one-clock/one-stamp/one-flush
+        implementation."""
+        return [asyncio.ensure_future(self.publish(action, msg))
+                for action, msg in pairs]
+
     def active_activations_for(self, namespace_id: str) -> int:
         raise NotImplementedError
 
@@ -388,15 +402,22 @@ class CommonLoadBalancer(LoadBalancer):
                 f"{'ACTIVE' if active else 'standby'}", "LoadBalancer")
 
     # -- dispatch (ref :175-198) -------------------------------------------
-    async def send_activation_to_invoker(self, msg: ActivationMessage,
-                                         invoker: InvokerInstanceId) -> None:
-        topic = invoker.as_string  # "invoker<N>"
+    def prepare_dispatch(self, msg: ActivationMessage,
+                         invoker: InvokerInstanceId) -> str:
+        """The synchronous half of a dispatch, shared by the serial send
+        and the batched publish path's task-free send: fence stamping and
+        the published counter live HERE so the two paths cannot drift.
+        Returns the invoker topic."""
         if self.fence_epoch is not None:
             # epoch fencing: invokers discard messages from a superseded
             # epoch, so a zombie active's late batches never double-run
             msg.fence_epoch = self.fence_epoch
         self.metrics.counter("loadbalancer_activations_published")
-        await self.producer.send(topic, msg)
+        return invoker.as_string  # "invoker<N>"
+
+    async def send_activation_to_invoker(self, msg: ActivationMessage,
+                                         invoker: InvokerInstanceId) -> None:
+        await self.producer.send(self.prepare_dispatch(msg, invoker), msg)
 
     # -- completion-ack feed (ref :205-346) --------------------------------
     def start_ack_feed(self) -> None:
@@ -710,3 +731,117 @@ class CommonLoadBalancer(LoadBalancer):
         self.metrics.unregister_renderer(self._profiler_renderer)
         self.metrics.unregister_renderer(self._anomaly_renderer)
         self.metrics.unregister_renderer(self._waterfall_renderer)
+
+
+def _bridge_publish_future(row: asyncio.Future, waiter: asyncio.Future) -> None:
+    """Wire one publish_many row future to its caller-facing waiter with
+    done-callbacks only — no task per activation. Result/exception copy
+    forward; a caller that goes away (waiter cancelled) cancels the row,
+    which the balancer's readback fan-out reads as an abandoned publisher
+    and returns the reserved capacity."""
+
+    def forward(f: asyncio.Future) -> None:
+        # retrieve the row's exception unconditionally: a row failing
+        # after its waiter was cancelled has nobody else to read it, and
+        # an unretrieved exception is loop-noise at GC time
+        exc = None if f.cancelled() else f.exception()
+        if waiter.done():
+            # waiter cancelled before the row resolved: the outcome is
+            # orphaned — a successful placement self-heals through the
+            # activation entry's forced timeout
+            return
+        if f.cancelled():
+            waiter.cancel()
+        elif exc is not None:
+            waiter.set_exception(exc)
+        else:
+            waiter.set_result(f.result())
+
+    def backward(w: asyncio.Future) -> None:
+        if w.cancelled() and not row.done():
+            row.cancel()
+
+    row.add_done_callback(forward)
+    waiter.add_done_callback(backward)
+
+
+class PublishCoalescer:
+    """Front-door publish batcher: concurrent `publish` calls in one
+    event-loop sweep reach the balancer as ONE `publish_many` batch.
+
+    The per-activation asyncio floor the host observatory measured lived
+    exactly here: every admitted activation minted a publish coroutine, a
+    flush-timer arm, a clock read and an arrival-EWMA blend of its own.
+    This coalescer queues `(action, msg)` on the caller's turn and drains
+    the queue with `loop.call_soon` — end-of-sweep, the bus coalescer's
+    zero-idle-latency rule, with NO drainer task — handing the whole
+    sweep's arrivals to `publish_many` in one call. Waiters resolve to
+    the completion promise (or the serial path's exact exceptions)
+    through done-callback bridges, so the publish hot path adds zero
+    tasks per activation.
+
+    Built only when the balancer advertises `batch_publish`
+    (CONFIG_whisk_loadBalancer_batchPublish; `maybe_batch_publish`
+    returns None otherwise and callers keep the serial `publish` path
+    bit-exactly)."""
+
+    def __init__(self, balancer, max_batch: Optional[int] = None):
+        self._bal = balancer
+        self.max_batch = max_batch or getattr(balancer, "max_batch", 256)
+        self._q: List[tuple] = []
+        self._armed = False
+        self.flushes = 0
+        self.submitted = 0
+
+    def submit(self, action, msg) -> asyncio.Future:
+        """Queue one publish; returns a future resolving to the
+        completion promise (what `await balancer.publish(...)` returns)."""
+        loop = asyncio.get_event_loop()
+        waiter: asyncio.Future = loop.create_future()
+        self._q.append((action, msg, waiter))
+        self.submitted += 1
+        if len(self._q) >= self.max_batch:
+            self._flush()
+        elif not self._armed:
+            self._armed = True
+            loop.call_soon(self._flush)
+        return waiter
+
+    async def publish(self, action, msg) -> asyncio.Future:
+        """Drop-in for `balancer.publish`: same awaited value, same
+        exceptions, batched under the hood."""
+        return await self.submit(action, msg)
+
+    def _flush(self) -> None:
+        self._armed = False
+        q, self._q = self._q, []
+        if not q:
+            return
+        self.flushes += 1
+        try:
+            rows = self._bal.publish_many([(a, m) for a, m, _w in q])
+        except Exception as e:  # noqa: BLE001 — a synchronously-raising
+            # publish_many must fail its waiters, not the event loop's
+            # call_soon handler
+            for _a, _m, w in q:
+                if not w.done():
+                    # fresh instance per waiter where the constructor
+                    # allows it: N waiters re-raising one shared object
+                    # interleave their __traceback__ frames
+                    try:
+                        exc = type(e)(*e.args)
+                    except Exception:  # noqa: BLE001 — exotic ctor
+                        exc = e
+                    w.set_exception(exc)
+            return
+        for (_a, _m, waiter), row in zip(q, rows):
+            _bridge_publish_future(row, waiter)
+
+
+def maybe_batch_publish(balancer) -> Optional[PublishCoalescer]:
+    """The wiring hook (the `maybe_coalesce` pattern): a PublishCoalescer
+    when the balancer runs the batched publish SPI, None — the serial
+    per-call path, bit-exact — otherwise."""
+    if getattr(balancer, "batch_publish", False):
+        return PublishCoalescer(balancer)
+    return None

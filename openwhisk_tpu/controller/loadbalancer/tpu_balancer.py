@@ -132,6 +132,16 @@ class PlacementPathConfig:
     #: container the virtual CPU devices from
     #: --xla_force_host_platform_device_count are the honest fallback.
     fleet_shards: int = 0
+    #: batch_publish: the batch-shaped publish SPI (ISSUE 14).
+    #: `publish_many` takes a whole admission batch in ONE call — one
+    #: clock read, one arrival-EWMA pass, one stamp_many, one NumPy
+    #: column pass into the request ring, one shared flush decision —
+    #: with per-row continuations as done-callbacks (zero tasks per
+    #: activation) instead of one publish coroutine (plus timer arm and
+    #: stamp) each. False routes publish_many through the serial
+    #: per-pair path, bit-exact; serial `publish` itself is untouched
+    #: either way.
+    batch_publish: bool = True
 
 
 def _next_pow2(n: int) -> int:
@@ -527,6 +537,7 @@ class TpuBalancer(CommonLoadBalancer):
                  calibrate_kernel: Optional[str] = None,
                  fleet_mesh: Optional[bool] = None,
                  fleet_shards: Optional[int] = None,
+                 batch_publish: Optional[bool] = None,
                  profiler=None, anomaly=None, waterfall=None):
         super().__init__(messaging_provider, controller_instance, logger,
                          metrics, profiler=profiler, anomaly=anomaly,
@@ -570,6 +581,21 @@ class TpuBalancer(CommonLoadBalancer):
         self._calibration: Optional[dict] = None
         self.adaptive_window = (adaptive_window if adaptive_window is not None
                                 else path_cfg.adaptive_window)
+        #: batch-shaped publish SPI (ISSUE 14): advertised to the front
+        #: end (maybe_batch_publish builds a PublishCoalescer off it)
+        self.batch_publish = (batch_publish if batch_publish is not None
+                              else path_cfg.batch_publish)
+        #: pure-function memos on the publish hot path: (ns, fqn) -> crc32
+        #: home hash and (step, size) -> modular inverse. Both are
+        #: deterministic (never invalidated); bounded by a clear at 64k.
+        self._hash_cache: Dict[tuple, int] = {}
+        self._modinv_cache: Dict[tuple, int] = {}
+        #: batched-publish send tasks — ONLY the raw-producer fallback
+        #: mints these (the coalescing producer's send_nowait path is
+        #: task-free; see _row_placed). close() drains them AFTER
+        #: failing queued publishers, so every caller-facing future
+        #: resolves before the producer goes away.
+        self._publish_finishers: set = set()
         #: publish inter-arrival EWMA (ms) — the adaptive window's pressure
         #: signal. Initialized sparse so a fresh balancer is eager.
         self._gap_ewma_ms = 1000.0
@@ -1415,6 +1441,14 @@ class TpuBalancer(CommonLoadBalancer):
             self.waterfall.discard(aid)
             if not fut.done():
                 fut.set_exception(LoadBalancerException("load balancer shut down"))
+        # batched-publish finishers drain AFTER the queued rows fail (every
+        # placement future they await is resolved by now — dispatched rows
+        # by the readback gather above, queued rows by the loop above) and
+        # BEFORE the producer closes, so every caller-facing future maps
+        # its outcome while sends still work
+        if self._publish_finishers:
+            await asyncio.gather(*list(self._publish_finishers),
+                                 return_exceptions=True)
         # releases queued during the readback drain (abandoned publishers)
         # will never reach a device step now — free their host slots
         for r in self._releases:
@@ -1424,27 +1458,47 @@ class TpuBalancer(CommonLoadBalancer):
         await super().close()
 
     # -- publish -----------------------------------------------------------
-    async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
-                      ) -> asyncio.Future:
+    def _standby_error(self) -> Optional[LoadBalancerException]:
+        """The pre-placement refusals shared by publish/publish_many."""
         if self.ha_standby:
             # HA failover mode: placement is fenced to the active leader —
             # refusing BEFORE any state change makes the 503 safe for the
             # edge to retry on the active upstream
-            raise LoadBalancerException(
+            return LoadBalancerException(
                 "standby controller: placement is fenced to the active "
                 "leader")
-        n = len(self._registry)
-        if n == 0 or not any(self._healthy):
-            raise LoadBalancerException(
+        if len(self._registry) == 0 or not any(self._healthy):
+            return LoadBalancerException(
                 "No invokers available to schedule the activation.")
-        meta = action.exec_metadata()
-        blackbox = meta.is_blackbox
+        return None
+
+    def _build_row(self, action: ExecutableWhiskAction,
+                   msg: ActivationMessage) -> tuple:
+        """One request row in packed-matrix order — the per-activation
+        half of publish, shared verbatim by the serial and batched paths
+        (parity by construction). The home hash and the modular inverse
+        are pure functions of their inputs, so both ride bounded memo
+        dicts; everything stateful (_rand_counter, the slot allocator,
+        slot-axis growth) mutates in exactly the serial order."""
+        n = len(self._registry)
+        blackbox = action.exec_metadata().is_blackbox
         size = self.blackbox_count if blackbox else self.managed_count
         offset = (n - self.blackbox_count) if blackbox else 0
         fqn_str = str(action.fully_qualified_name)
-        h = generate_hash(str(msg.user.namespace.name), fqn_str)
+        hkey = (str(msg.user.namespace.name), fqn_str)
+        h = self._hash_cache.get(hkey)
+        if h is None:
+            if len(self._hash_cache) >= 65536:
+                self._hash_cache.clear()
+            h = self._hash_cache[hkey] = generate_hash(*hkey)
         steps = self._steps_blackbox if blackbox else self._steps_managed
         step = steps[h % len(steps)]
+        ikey = (step, size)
+        step_inv = self._modinv_cache.get(ikey)
+        if step_inv is None:
+            if len(self._modinv_cache) >= 65536:
+                self._modinv_cache.clear()
+            step_inv = self._modinv_cache[ikey] = _mod_inverse(step, size)
         self._rand_counter += 1
         mem = action.limits.memory.megabytes
         maxc = action.limits.concurrency.max_concurrent
@@ -1455,10 +1509,18 @@ class TpuBalancer(CommonLoadBalancer):
         # call instead of a per-field Python fill loop
         ns_slot = (self._ns_slot(msg.user.namespace.uuid.asString)
                    if self.rate_limit_per_minute is not None else 0)
-        req = (offset, size, h % size, _mod_inverse(step, size), mem,
+        req = (offset, size, h % size, step_inv, mem,
                self._slots.acquire(slot_key), maxc,
                (h ^ (self._rand_counter * 2654435761)) % max(size, 1), 1,
                ns_slot)
+        return req, slot_key, fqn_str
+
+    async def publish(self, action: ExecutableWhiskAction, msg: ActivationMessage
+                      ) -> asyncio.Future:
+        err = self._standby_error()
+        if err is not None:
+            raise err
+        req, slot_key, fqn_str = self._build_row(action, msg)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         # trailing fields feed the flight recorder: enqueue time (queue-age
         # digest), the activation/action ids for the decision row, and the
@@ -1511,28 +1573,239 @@ class TpuBalancer(CommonLoadBalancer):
             # the eviction cap pushed out a LIVE activation's instead)
             self.waterfall.discard(aid_str)
             raise
+        invoker, promise = self._map_placement(inv_idx, forced, req,
+                                               slot_key, aid_str, msg, action)
+        await self.send_activation_to_invoker(msg, invoker)
+        return promise
+
+    def _map_placement(self, inv_idx: int, forced, req: tuple,
+                       slot_key: str, aid: str, msg, action):
+        """The post-placement outcome mapping, shared by the serial
+        `publish` and the batched `_row_placed` continuation so the two
+        paths cannot drift: failure codes release the held capacity,
+        discard the stage vector and raise the serial exception texts;
+        success books the forced counter, sets up the activation entry
+        and returns (invoker, completion promise)."""
         if inv_idx == -2:
             # device token bucket rejected it: no capacity was consumed
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
-            self.waterfall.discard(aid_str)
+            self.waterfall.discard(aid)
             self.metrics.counter("loadbalancer_device_throttled")
             raise LoadBalancerThrottleException(
                 "Too many requests in the last minute (device rate "
                 "admission).")
         if inv_idx < 0:
             self._slots.release(slot_key, req[self.R_CONC_SLOT])
-            self.waterfall.discard(aid_str)
+            self.waterfall.discard(aid)
             raise LoadBalancerException(
                 "No invokers available to schedule the activation.")
         if forced:
             self.metrics.counter("loadbalancer_forced_placements")
         invoker = self._registry[inv_idx]
         promise = self.setup_activation(msg, action, invoker)
-        entry = self.activation_slots.get(msg.activation_id.asString)
+        entry = self.activation_slots.get(aid)
         if entry is not None:
             entry.conc_slot = req[self.R_CONC_SLOT]
-        await self.send_activation_to_invoker(msg, invoker)
-        return promise
+        return invoker, promise
+
+    def publish_many(self, pairs) -> List[asyncio.Future]:
+        """The batch-shaped publish SPI (ISSUE 14): one call schedules a
+        whole admission batch. Against N serial publishes this pays ONE
+        clock read + arrival-EWMA pass (`_note_arrivals`), ONE
+        `stamp_many(PUBLISH_ENQUEUE)`, ONE NumPy column pass into the
+        request ring (`push_block`), ONE shared flush decision — the
+        whole batch lands in one device micro-batch instead of an eager
+        head-of-batch dispatch of 1 — with per-row continuations as
+        done-callbacks (`_row_placed`: zero tasks, sends handed to the
+        bus coalescer task-free). Each returned future
+        resolves to the completion promise (what `publish` returns) or
+        raises `publish`'s exact exceptions; per-row decisions, waterfall
+        stamps, 429 texts and abandonment capacity-returns are the serial
+        path's, row for row (parity-fuzzed). Off switch
+        (CONFIG_whisk_loadBalancer_batchPublish=false): the serial
+        per-pair default."""
+        if not self.batch_publish:
+            return super().publish_many(pairs)
+        loop = asyncio.get_event_loop()
+        outs: List[asyncio.Future] = [loop.create_future() for _ in pairs]
+        err = self._standby_error()
+        if err is not None:
+            # fresh exception instance per row (serial parity: each
+            # publish call raises its own) — N waiters re-raising one
+            # shared object interleave their __traceback__ frames
+            for out in outs:
+                out.set_exception(type(err)(*err.args))
+            return outs
+        built: List[tuple] = []
+        for (action, msg), out in zip(pairs, outs):
+            try:
+                req, slot_key, fqn_str = self._build_row(action, msg)
+            except Exception as e:  # noqa: BLE001 — per-row isolation,
+                # like N independent publish calls: one bad row must not
+                # strand its batch-mates
+                out.set_exception(e)
+                continue
+            built.append((req, loop.create_future(), slot_key,
+                          msg.activation_id.asString, msg, action, out,
+                          fqn_str))
+        if not built:
+            return outs
+        # the serial path notes an arrival only AFTER a successful row
+        # build (a raising _build_row never reaches _note_arrival), so
+        # the shared clock read counts built rows, not offered pairs —
+        # else a burst of failing rows would decay the arrival EWMA and
+        # flip _coalesce_window_s where serial stays eager
+        t_now = time.monotonic()
+        self._note_arrivals(t_now, len(built))
+        if self.ring_assembly:
+            # the NumPy column pass: every built row's packed column lands
+            # in the preallocated ring in one [rows, k] block write (two
+            # slice copies), replacing k per-row ring assignments. The
+            # pending entries append in the SAME synchronous block, so the
+            # two FIFOs cannot desync.
+            self._req_ring.push_block(
+                np.asarray([b[0] for b in built], np.int32).T)
+        for req, fut, slot_key, aid, msg, _action, _out, fqn_str in built:
+            self._pending.append((req, fut, slot_key, t_now, aid, fqn_str,
+                                  trace_id_of(msg.trace_context)))
+        self.waterfall.stamp_many([b[3] for b in built],
+                                  STAGE_PUBLISH_ENQUEUE)
+        self.metrics.histogram("loadbalancer_publish_batch_size",
+                               len(built))
+        # ONE shared flush decision for the whole admission batch (the
+        # serial path decides per row, which at idle eagerly dispatches a
+        # 1-deep device step for the batch's FIRST row): drain full
+        # buckets inline, then apply the serial eager/window rule once.
+        while (len(self._pending) >= self.max_batch
+               and self._try_flush_now()):
+            pass
+        if self._pending and not (
+                self._inflight_steps == 0
+                and self._rtt_ewma_ms < self.RTT_FAST_MS
+                and self._coalesce_window_s() == 0.0
+                and self._try_flush_now()):
+            self._arm_flush(urgent=len(self._pending) >= self.max_batch)
+        # per-row continuations are DONE-CALLBACKS, not a task: at sweep
+        # depth (a few rows per event-loop sweep at moderate rates) a
+        # per-batch finisher task costs more than the per-row work it
+        # amortizes — measured as a ~0.7 tasks/activation regression.
+        # The callback chain mints zero loop objects beyond the two
+        # futures the SPI contract needs, and the caller-cancellation
+        # bridge makes the readback fan-out read a gone caller as an
+        # abandoned publisher (capacity returned per row).
+        for b in built:
+            req, fut, slot_key, aid, msg, action, out, _fqn = b
+            out.add_done_callback(
+                lambda o, f=fut: (f.cancel() if (o.cancelled()
+                                                 and not f.done())
+                                  else None))
+            fut.add_done_callback(
+                lambda f, r=req, sk=slot_key, a=aid, m=msg, ac=action,
+                o=out: self._row_placed(f, r, sk, a, m, ac, o))
+        return outs
+
+    def _row_placed(self, fut: asyncio.Future, req: tuple, slot_key: str,
+                    aid: str, msg, action, out: asyncio.Future) -> None:
+        """One batched-publish row's continuation (a done-callback on its
+        placement future): the serial publish's post-placement body —
+        error mapping, activation setup, fencing — then the dispatch send
+        handed to the bus coalescer WITHOUT awaiting (its flush future
+        resolves `out`, so send failures still surface exactly like the
+        serial path's raised send errors). All rows of a readback wave run
+        their callbacks in one sweep, so their sends coalesce into the
+        same bus frames the serial path's fan-out produced."""
+        wf = self.waterfall
+        try:
+            if fut.cancelled():
+                # abandoned row: the readback fan-out (or the bridge
+                # racing an unplaced row) already returned the capacity
+                # and dropped the stage vector
+                return
+            exc = fut.exception()
+            if exc is not None:
+                # dispatch failure: the failing device step already
+                # released this row's slot and discarded its vector
+                if not out.done():
+                    out.set_exception(exc)
+                return
+            inv_idx, forced = fut.result()
+            if out.cancelled():
+                # caller went away between the fan-out resolving the row
+                # and this callback — the serial CancelledError branch
+                self._abandon_placement(int(inv_idx), req, slot_key)
+                wf.discard(aid)
+                return
+            # outcome mapping shared verbatim with the serial publish
+            # (_map_placement): failure codes release capacity, discard
+            # the vector and raise the serial texts — the enclosing
+            # except hands them to `out` exactly like a serial raise
+            invoker, promise = self._map_placement(inv_idx, forced, req,
+                                                   slot_key, aid, msg,
+                                                   action)
+            send_nowait = getattr(self.producer, "send_nowait", None)
+            if send_nowait is not None:
+                # fence stamping + published counter shared with the
+                # serial send (prepare_dispatch), so the two paths
+                # cannot drift. Note this task-free submit is the one
+                # dispatch that does NOT flow through the
+                # send_activation_to_invoker hook — minting a coroutine
+                # per row to honor it would be the exact per-activation
+                # floor this path removes.
+                topic = self.prepare_dispatch(msg, invoker)
+                sendf = send_nowait(topic, msg)
+
+                def _sent(sf: asyncio.Future) -> None:
+                    # retrieve the flush outcome UNCONDITIONALLY (before
+                    # any early-return): a caller gone by cancellation
+                    # must not leave an unretrieved flush exception
+                    # spamming the loop's GC-time logger
+                    send_exc = (None if sf.cancelled()
+                                else sf.exception())
+                    if out.done():
+                        return
+                    if sf.cancelled():
+                        # the coalescer's drainer was cancelled with the
+                        # dispatch still queued (loop teardown): serial
+                        # parity is the awaited send RAISING
+                        # CancelledError to the caller — never success
+                        # for an unsent dispatch
+                        out.cancel()
+                        return
+                    if send_exc is not None:
+                        # serial parity: the entry stays; the forced
+                        # timeout self-heals the held capacity
+                        out.set_exception(send_exc)
+                    else:
+                        out.set_result(promise)
+
+                sendf.add_done_callback(_sent)
+            else:
+                # raw (non-coalescing) producer: no task-free submit —
+                # one send task per row, the serial cost (this is the
+                # coalescing-off configuration, not the hot path). The
+                # task awaits send_activation_to_invoker (which runs
+                # prepare_dispatch itself), so the documented dispatch
+                # hook keeps covering this path for subclasses/tests.
+                task = asyncio.get_event_loop().create_task(
+                    self._send_then_resolve(invoker, msg, out, promise))
+                self._publish_finishers.add(task)
+                task.add_done_callback(self._publish_finishers.discard)
+        except Exception as e:  # noqa: BLE001 — a raising done-callback
+            # would land in the loop's exception handler and strand the
+            # caller: fail the row instead
+            if not out.done():
+                out.set_exception(e)
+
+    async def _send_then_resolve(self, invoker, msg, out: asyncio.Future,
+                                 promise) -> None:
+        try:
+            await self.send_activation_to_invoker(msg, invoker)
+        except Exception as e:  # noqa: BLE001
+            if not out.done():
+                out.set_exception(e)
+            return
+        if not out.done():
+            out.set_result(promise)
 
     def _abandon_placement(self, inv_idx: int, req: tuple, slot_key: str) -> None:
         """A publisher went away (client disconnect) after its request was
@@ -2004,6 +2277,20 @@ class TpuBalancer(CommonLoadBalancer):
         self._last_gap_ms = gap_ms
         self._gap_ewma_ms = min(0.9 * self._gap_ewma_ms + 0.1 * gap_ms,
                                 1000.0)
+
+    def _note_arrivals(self, now: float, n: int) -> None:
+        """Arrival accounting for a whole admission batch at ONE shared
+        clock read (the ISSUE 14 small fix: the serial path paid a
+        time.monotonic() + blend per activation). Equivalent to n serial
+        `_note_arrival(now)` calls: the first blends the real gap, the
+        remaining n-1 blend zero gaps — a pure 0.9^(n-1) decay, applied
+        in closed form (the 1000 ms clamp only ever binds on the first
+        blend, since decay shrinks). At n=1 this IS `_note_arrival`,
+        bit-exact."""
+        self._note_arrival(now)
+        if n > 1:
+            self._gap_ewma_ms *= 0.9 ** (n - 1)
+            self._last_gap_ms = 0.0
 
     def _coalesce_window_s(self) -> float:
         """> 0 when arrival pressure says windowed batching beats eager

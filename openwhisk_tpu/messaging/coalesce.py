@@ -64,6 +64,13 @@ class BusCoalesceConfig:
     #: flush time, so a message serialized for a batch is encoded exactly
     #: once). False restores the serial wire format byte-exactly.
     batch_wire: bool = True
+    #: lazy ack result column (ISSUE 14): ack batch frames carry each
+    #: activation's response payload as an opaque bytes column after the
+    #: JSON header, so the controller's completion loop never parses a
+    #: result nobody reads (blocking invokes parse on the API turn;
+    #: fire-and-forget acks skip the parse entirely). False restores the
+    #: PR 11 ack batch record byte-exactly.
+    lazy_results: bool = True
 
     @classmethod
     def from_env(cls) -> "BusCoalesceConfig":
@@ -76,9 +83,11 @@ class CoalescingProducer(MessageProducer):
     (utils/microbatch.py) — the admission plane rides the same one."""
 
     def __init__(self, inner: MessageProducer, max_batch: int = 64,
-                 window_ms: float = 0.0, batch_wire: bool = False):
+                 window_ms: float = 0.0, batch_wire: bool = False,
+                 lazy_results: bool = False):
         self.inner = inner
         self.batch_wire = batch_wire
+        self.lazy_results = lazy_results
         self._co = MicroCoalescer(self._ship, max_batch,
                                   max(0.0, float(window_ms)) / 1e3,
                                   name="bus-coalesce-drain")
@@ -108,6 +117,12 @@ class CoalescingProducer(MessageProducer):
                 return
         payload = encode_message(msg)
         await self._co.submit((topic, payload, msg))
+
+    def send_nowait(self, topic: str, msg) -> "asyncio.Future":
+        """Public task-free submit (the batched publish SPI resolves its
+        callers from this future's done-callback): enqueue now, flush
+        with the coalescer's next drain."""
+        return self._submit_nowait(topic, msg)
 
     def _submit_nowait(self, topic: str, msg) -> "asyncio.Future":
         """send() without the await: enqueue, return the flush future."""
@@ -191,7 +206,8 @@ class CoalescingProducer(MessageProducer):
                         self._fail_group(group, e)
                     continue
                 try:
-                    payload, batch_msg = encode_batch(family, msgs)
+                    payload, batch_msg = encode_batch(
+                        family, msgs, lazy_results=self.lazy_results)
                 except Exception:  # noqa: BLE001 — deferring the encode
                     # to flush time must NOT widen one bad message's
                     # blast radius to the whole flush (the serial path
@@ -237,7 +253,8 @@ def maybe_coalesce(producer: MessageProducer,
     if not cfg.enabled or isinstance(producer, CoalescingProducer):
         return producer
     return CoalescingProducer(producer, cfg.max_batch, cfg.window_ms,
-                              batch_wire=cfg.batch_wire)
+                              batch_wire=cfg.batch_wire,
+                              lazy_results=cfg.lazy_results)
 
 
 def export_coalesce_gauges(metrics) -> None:

@@ -38,6 +38,7 @@ encoded payload per message — the serial wire format, byte-exact).
 from __future__ import annotations
 
 import json
+import logging
 from typing import Dict, List, Optional, Tuple
 
 from ..core.entity import ActivationId, ControllerInstanceId, Identity
@@ -50,13 +51,29 @@ from .message import (AcknowledgementMessage, ActivationMessage,
 #: every batch payload leads with this key (json.dumps preserves insertion
 #: order, so the magic is a stable byte prefix — the cheap routing test)
 BATCH_MAGIC = b'{"whiskBatch":'
+#: the lazy ack frame's exact serialized prefix (compact json.dumps puts
+#: the magic key first): parse_batch sniffs THIS before paying a
+#: full-payload newline scan that plain frames can never satisfy
+_LAZY_PREFIX = b'{"whiskBatch":"ackL"'
 
 KIND_ACTIVATION = "act1"
 KIND_ACK = "ack1"
+#: the LAZY ack frame (ISSUE 14): a JSON header (columns + respLen) then
+#: one raw newline then the concatenated per-row response payloads as
+#: opaque bytes. json.dumps never emits a raw newline (strings escape
+#: theirs), so the first b"\n" in a batch payload is always this frame
+#: delimiter and plain frames never contain one.
+KIND_ACK_LAZY = "ackL"
 
 #: serde hop labels by batch kind (mirrors connector._SERDE_HOPS so the
 #: host observatory's per-hop accounting survives the batch wire)
-_BATCH_HOPS = {KIND_ACTIVATION: "activation", KIND_ACK: "completion_ack"}
+_BATCH_HOPS = {KIND_ACTIVATION: "activation", KIND_ACK: "completion_ack",
+               KIND_ACK_LAZY: "completion_ack"}
+
+#: the deferred result parse books its cost under its OWN hop, so the
+#: "consumer never reads the result" case is visible as a ZERO row here
+#: while the frame decode stays under completion_ack
+LAZY_RESULT_HOP = "ack_result"
 
 
 def is_batch_payload(raw) -> bool:
@@ -225,27 +242,104 @@ _ACK_CODES = {"completion": "c", "result": "r", "combined": "b"}
 _ACK_KINDS = {v: k for k, v in _ACK_CODES.items()}
 
 
+class LazyWhiskActivation:
+    """A WhiskActivation that stays raw bytes until somebody reads it.
+
+    The lazy ack frame (ISSUE 14) ships each activation's response
+    payload as an opaque bytes column; the completion hot loop
+    (`process_acknowledgements`) only needs the ack COLUMNS (id, invoker,
+    system-error bit) — the response is dead weight there. This proxy
+    carries the raw payload through the promise plumbing and parses it on
+    the first attribute access, which for a blocking invoke happens on
+    the API handler's own turn and for a fire-and-forget ack happens
+    never. The deferred parse books its bytes + wall time under the
+    `ack_result` serde hop, so skipped parses are a measurable zero."""
+
+    __slots__ = ("raw", "_obj")
+
+    def __init__(self, raw: bytes):
+        self.raw = raw
+        self._obj = None
+
+    @property
+    def materialized(self) -> bool:
+        return self._obj is not None
+
+    def _materialize(self):
+        obj = self._obj
+        if obj is None:
+            from ..core.entity import WhiskActivation
+            from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY
+            obs = GLOBAL_HOST_OBSERVATORY
+            try:
+                if obs.serde_active:
+                    import time as _time
+                    t0 = _time.perf_counter_ns()
+                    obj = WhiskActivation.from_json(json.loads(self.raw))
+                    obs.serde_observe(LAZY_RESULT_HOP, "deserialize",
+                                      len(self.raw),
+                                      _time.perf_counter_ns() - t0)
+                else:
+                    obj = WhiskActivation.from_json(json.loads(self.raw))
+            except Exception as e:
+                # a corrupt body behind a CONSISTENT lazy frame (header +
+                # lengths fine, payload garbled) is by design undetectable
+                # until this first read — the eager wire's decode-time
+                # "corrupt completion ack" drop can't apply. Surface a
+                # well-defined, logged error here instead of letting a
+                # JSONDecodeError/KeyError escape deep inside whatever
+                # consumer touched the first attribute.
+                logging.warning("corrupt lazy ack result (%dB): %r",
+                                len(self.raw), e)
+                raise ValueError(
+                    f"corrupt lazy ack result: {e!r}") from e
+            self._obj = obj
+        return obj
+
+    def __getattr__(self, name):
+        # only reached for names not in __slots__/class dict: every real
+        # WhiskActivation attribute (activation_id, response, to_json...)
+        # lands here and forces the parse
+        return getattr(self._materialize(), name)
+
+    def __repr__(self) -> str:  # no parse for logging
+        state = "parsed" if self._obj is not None else f"{len(self.raw)}B raw"
+        return f"LazyWhiskActivation({state})"
+
+
 class AckBatchMessage(Message):
     """N invoker->controller acks as one columnar wire record. The heavy
     per-row payload (the WhiskActivation response) stays per-row — it IS
     the data — but the batch pays ONE json.dumps/loads for all of them,
-    and the invoker table dedups the repeated instance id."""
+    and the invoker table dedups the repeated instance id.
 
-    def __init__(self, msgs: List[AcknowledgementMessage]):
+    `lazy_results=True` (the ISSUE 14 wire) moves the response payloads
+    OUT of the JSON record: the frame becomes a JSON header (columns +
+    a `respLen` byte-length column) followed by one raw newline and the
+    concatenated response payloads as opaque bytes. The decode side then
+    never parses a response the consumer doesn't read — the controller's
+    completion loop only touches the columns. False keeps the PR 11
+    format byte-exact."""
+
+    def __init__(self, msgs: List[AcknowledgementMessage],
+                 lazy_results: bool = False):
         self.msgs = msgs
+        self.lazy_results = lazy_results
 
     @property
     def activation_ids(self) -> List[str]:
         return [m.activation_id.asString for m in self.msgs]
 
-    def to_json(self) -> dict:
+    def _columns(self) -> dict:
+        """The shared (response-free) ack columns: the eager record and
+        the lazy header carry their responses differently, so each
+        caller builds its own resp column."""
         invs = _Dedup()
         kinds: List[str] = []
         tx_col: List[object] = []
         ids: List[str] = []
         iv_col: List[int] = []
         err_col: List[int] = []
-        resp_col: List[Optional[dict]] = []
         for m in self.msgs:
             kinds.append(_ACK_CODES.get(m.kind, "b"))
             tx_col.append(m.transid.to_json())
@@ -254,15 +348,39 @@ class AckBatchMessage(Message):
                           else invs.intern(m.invoker.as_string,
                                            m.invoker.to_json()))
             err_col.append(1 if m.is_system_error else 0)
-            resp_col.append(m.activation.to_json()
-                            if m.activation is not None else None)
-        return {
-            "whiskBatch": KIND_ACK,
-            "invs": invs.values,
-            "kinds": "".join(kinds),
-            "tx": tx_col, "ids": ids, "iv": iv_col, "err": err_col,
-            "resp": resp_col,
-        }
+        return {"invs": invs.values, "kinds": "".join(kinds),
+                "tx": tx_col, "ids": ids, "iv": iv_col, "err": err_col}
+
+    def to_json(self) -> dict:
+        out = {"whiskBatch": KIND_ACK}
+        out.update(self._columns())
+        out["resp"] = [m.activation.to_json()
+                       if m.activation is not None else None
+                       for m in self.msgs]
+        return out
+
+    @staticmethod
+    def _resp_bytes(m: AcknowledgementMessage) -> bytes:
+        """One row's opaque response payload. A still-raw relay (a
+        LazyWhiskActivation nobody parsed) passes its bytes through
+        untouched — re-encoding an unread payload would be the exact
+        serde cost the lazy column exists to skip."""
+        act = m.activation
+        if act is None:
+            return b""
+        if isinstance(act, LazyWhiskActivation) and not act.materialized:
+            return act.raw
+        return json.dumps(act.to_json(), separators=(",", ":")).encode()
+
+    def serialize(self) -> bytes:
+        if not self.lazy_results:
+            return super().serialize()
+        bodies = [self._resp_bytes(m) for m in self.msgs]
+        header = {"whiskBatch": KIND_ACK_LAZY}
+        header.update(self._columns())
+        header["respLen"] = [len(b) for b in bodies]
+        return (json.dumps(header, separators=(",", ":")).encode()
+                + b"\n" + b"".join(bodies))
 
     @staticmethod
     def parse(raw) -> List[AcknowledgementMessage]:
@@ -291,21 +409,77 @@ class AckBatchMessage(Message):
                                                               inv))
         return out
 
+    @staticmethod
+    def from_lazy(header: dict, body: bytes) -> List[AcknowledgementMessage]:
+        """Decode the lazy frame WITHOUT touching a single response byte
+        beyond slicing: every ack field comes from the columns (the
+        `err` bit was computed at encode time from the same response the
+        eager path would re-derive it from), and each present response
+        becomes a LazyWhiskActivation over its body slice. Building the
+        base AcknowledgementMessage directly — instead of the kind
+        subclasses — matters: ResultMessage reads activation_id off the
+        activation and CombinedCompletionAndResultMessage reads
+        response.is_whisk_error, either of which would force the parse
+        this frame exists to defer."""
+        from ..core.entity import InvokerInstanceId
+        invs = [InvokerInstanceId.from_json(v) for v in header["invs"]]
+        lens = header["respLen"]
+        out: List[AcknowledgementMessage] = []
+        off = 0
+        for code, tx, aid, iv, err, ln in zip(
+                header["kinds"], header["tx"], header["ids"], header["iv"],
+                header["err"], lens):
+            raw = body[off:off + ln] if ln else b""
+            off += ln
+            ack = AcknowledgementMessage(
+                TransactionId.from_json(tx), ActivationId(aid),
+                invs[iv] if iv >= 0 else None, bool(err),
+                LazyWhiskActivation(raw) if raw else None)
+            ack.kind = _ACK_KINDS.get(code, "combined")
+            out.append(ack)
+        if off != len(body):
+            raise ValueError(
+                f"lazy ack frame body length {len(body)} != respLen "
+                f"sum {off}")
+        return out
 
-def make_batch(family: str, msgs: list) -> Message:
+
+def make_batch(family: str, msgs: list,
+               lazy_results: bool = False) -> Message:
     """Wrap same-family messages into their batch record (the
-    `serialize_many` entry point the coalescing producer uses)."""
+    `serialize_many` entry point the coalescing producer uses).
+    `lazy_results` selects the ISSUE 14 lazy ack frame for the ack
+    family; activation batches ignore it (their args ARE read by every
+    consumer)."""
     if family == KIND_ACTIVATION:
         return ActivationBatchMessage(msgs)
     if family == KIND_ACK:
-        return AckBatchMessage(msgs)
+        return AckBatchMessage(msgs, lazy_results=lazy_results)
     raise ValueError(f"not a batchable family: {family!r}")
 
 
 def parse_batch(raw) -> Tuple[str, list]:
     """Decode one batch payload -> (kind, [messages]). The caller sniffs
     with is_batch_payload first; an unknown kind raises ValueError (the
-    feed's corrupt-message posture)."""
+    feed's corrupt-message posture). A lazy ack frame splits at its
+    first raw newline (plain JSON frames never contain one) and parses
+    ONLY the header — the response payloads stay opaque slices."""
+    if isinstance(raw, str):
+        raw = raw.encode()
+    raw = bytes(raw)
+    # sniff the fixed lazy prefix BEFORE scanning for the delimiter:
+    # plain frames can never contain a raw newline, so the full-payload
+    # memchr would be guaranteed-miss work on the completion hot loop's
+    # biggest byte streams (eager ack frames carrying whole responses)
+    if raw.startswith(_LAZY_PREFIX):
+        nl = raw.find(b"\n")
+        if nl < 0:
+            raise ValueError("lazy ack frame missing its body delimiter")
+        header = json.loads(raw[:nl])
+        kind = header.get("whiskBatch")
+        if kind != KIND_ACK_LAZY:
+            raise ValueError(f"framed batch with unknown kind {kind!r}")
+        return kind, AckBatchMessage.from_lazy(header, raw[nl + 1:])
     j = json.loads(raw)
     kind = j.get("whiskBatch")
     if kind == KIND_ACTIVATION:

@@ -67,15 +67,17 @@ def decode_message(parse, raw, hop: str):
     return msg
 
 
-def encode_batch(family: str, msgs: list) -> Tuple[bytes, object]:
+def encode_batch(family: str, msgs: list,
+                 lazy_results: bool = False) -> Tuple[bytes, object]:
     """ONE serialize for a whole same-family micro-batch (the columnar
     batch wire, messaging/columnar.py). Returns (payload, batch_message);
     the host observatory books the batch's bytes + wall time under the
     SAME hop label as N serial encodes would have used — so the serde
     counters stay comparable across the knob, and the per-hop byte totals
-    measure the dedup win directly."""
+    measure the dedup win directly. `lazy_results` selects the ISSUE 14
+    lazy ack frame (opaque response-bytes column) for ack batches."""
     from .columnar import batch_hop_of, make_batch
-    batch_msg = make_batch(family, msgs)
+    batch_msg = make_batch(family, msgs, lazy_results=lazy_results)
     obs = GLOBAL_HOST_OBSERVATORY
     if not obs.serde_active:
         return batch_msg.serialize(), batch_msg

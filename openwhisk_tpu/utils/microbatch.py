@@ -72,40 +72,63 @@ class MicroCoalescer:
             self._drainer = asyncio.get_event_loop().create_task(
                 self._drain(), name=self.name)
 
+    #: post-drain linger: how many ZERO-DELAY sweeps an emptied drainer
+    #: waits for the next wave before exiting. Steady traffic re-fills
+    #: within a sweep or two, and re-arming a fresh drainer task per wave
+    #: was measurable churn (~0.2 tasks/activation at 4k/s across the
+    #: process's producers). A submission landing during the linger
+    #: flushes on the NEXT sweep — exactly when a freshly-armed drainer
+    #: would have — so the zero-idle-latency contract is unchanged.
+    LINGER_SWEEPS = 32
+
     async def _drain(self) -> None:
         loop = asyncio.get_event_loop()
         batch: List[tuple] = []
         try:
-            while self._pending:
-                if len(self._pending) < self.max_batch:
-                    if self.window_s > 0:
-                        lag = self.window_s - (loop.time()
-                                               - self._pending[0][2])
-                        if lag > 0:
-                            # interruptible window: a batch filling while
-                            # we sleep flushes NOW (submit sets _full)
-                            self._full.clear()
-                            if len(self._pending) < self.max_batch:
-                                try:
-                                    await asyncio.wait_for(
-                                        self._full.wait(), lag)
-                                except asyncio.TimeoutError:
-                                    pass
+            while True:
+                while self._pending:
+                    if len(self._pending) < self.max_batch:
+                        if self.window_s > 0:
+                            lag = self.window_s - (loop.time()
+                                                   - self._pending[0][2])
+                            if lag > 0:
+                                # interruptible window: a batch filling
+                                # while we sleep flushes NOW (submit
+                                # sets _full)
+                                self._full.clear()
+                                if len(self._pending) < self.max_batch:
+                                    try:
+                                        await asyncio.wait_for(
+                                            self._full.wait(), lag)
+                                    except asyncio.TimeoutError:
+                                        pass
+                        else:
+                            await asyncio.sleep(0)  # end-of-sweep coalesce
+                    batch = [(item, fut) for (item, fut, _t)
+                             in self._pending[:self.max_batch]]
+                    del self._pending[:len(batch)]
+                    try:
+                        await self._flush(batch)
+                    except Exception as e:  # noqa: BLE001 — fan out to
+                        # waiters
+                        for _item, fut in batch:
+                            if not fut.done():
+                                fut.set_exception(e)
                     else:
-                        await asyncio.sleep(0)  # end-of-sweep coalesce
-                batch = [(item, fut)
-                         for (item, fut, _t) in self._pending[:self.max_batch]]
-                del self._pending[:len(batch)]
-                try:
-                    await self._flush(batch)
-                except Exception as e:  # noqa: BLE001 — fan out to waiters
-                    for _item, fut in batch:
-                        if not fut.done():
-                            fut.set_exception(e)
-                else:
-                    for _item, fut in batch:
-                        if not fut.done():
-                            fut.set_result(None)
+                        for _item, fut in batch:
+                            if not fut.done():
+                                fut.set_result(None)
+                for _ in range(self.LINGER_SWEEPS):
+                    await asyncio.sleep(0)
+                    if self._pending:
+                        break
+                # liveness: the empty check is SYNCHRONOUS right before
+                # the return (no await in between), and submitters re-arm
+                # whenever the previous drainer is done() — a submission
+                # can never strand between the check and the task
+                # finishing
+                if not self._pending:
+                    return
         except asyncio.CancelledError:
             # the loop is going down mid-drain (sleep or flush cancelled):
             # nobody will ever flush the remainder — cancel every waiter

@@ -115,6 +115,24 @@ class ColumnRing:
         self.buf[:, (self.head + self.count) % cap] = col
         self.count += 1
 
+    def push_block(self, block) -> None:
+        """Append `block.shape[1]` columns in at most two contiguous slice
+        copies — the batched-publish analogue of N push() calls (one
+        NumPy pass for a whole admission batch instead of one per-column
+        assignment per activation). `block` is int-like [rows, k]."""
+        k = int(block.shape[1])
+        if k == 0:
+            return
+        while self.count + k > self.buf.shape[1]:
+            self._grow()
+        cap = self.buf.shape[1]
+        start = (self.head + self.count) % cap
+        first = min(k, cap - start)
+        self.buf[:, start:start + first] = block[:, :first]
+        if k > first:
+            self.buf[:, :k - first] = block[:, first:]
+        self.count += k
+
     def pop_into(self, out, k: int) -> None:
         """Copy the k oldest columns into out[:, :k] (out may carry fewer
         rows than the ring: extra ring rows are dropped) and consume them."""
