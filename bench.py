@@ -1789,6 +1789,388 @@ def _failover_downtime(rate: float = 128.0, duration: float = 2.0,
         return None
 
 
+def _partition_chaos(rate: float = 64.0, duration: float = 3.0,
+                     n_invokers: int = 8, n_partitions: int = 8
+                     ) -> Optional[dict]:
+    """ISSUE 15 rider: active/active partitioned control under a kill.
+    THREE active journaled controllers share a MemoryMessagingProvider
+    bus + a fenced echo fleet; the partition ring spreads 8 namespaces
+    over them and an open-loop NO-RETRY burst drives all three through
+    an edge-like owner-first router (bounded retry on refusal only —
+    exactly the 503-safe retry, so a retry can never double-execute).
+    Mid-burst one active is killed (membership silenced, its queue
+    dropped, its journal detached mid-flight — crash semantics); the
+    survivors must detect the silence, claim its partitions at bumped
+    epochs, absorb its journal tail filtered to those partitions, and
+    keep serving every namespace. A post-kill ZOMBIE salvo is then
+    driven at the dead controller's still-live object: its dispatches
+    carry superseded epochs — invokers that already heard the bumped
+    epoch for the partition discard them, ones that haven't yet run the
+    fresh row once (the per-invoker fence is eventually-consistent;
+    fenced + executed must cover the whole salvo). Reports downtime
+    (detection excluded and reported separately, as in the PR 8
+    failover rider), double-executions (duplicate side effects — must
+    be 0), zombie salvo accounting, absorbed-partition rate, journal
+    seq integrity (zero lost/duplicated per journal), and the retry
+    bound."""
+    import os
+    import shutil
+    import tempfile
+
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.controller.loadbalancer.journal import PlacementJournal
+    from openwhisk_tpu.controller.loadbalancer.membership import (
+        ControllerMembership)
+    from openwhisk_tpu.controller.loadbalancer.partitions import PartitionRing
+    from openwhisk_tpu.core.entity import (ActivationId, ActivationResponse,
+                                           ControllerInstanceId, EntityPath,
+                                           Identity, InvokerInstanceId, MB,
+                                           WhiskActivation)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         CombinedCompletionAndResultMessage,
+                                         MemoryMessagingProvider, MessageFeed,
+                                         PingMessage, maybe_coalesce)
+    from openwhisk_tpu.messaging.columnar import is_batch_payload
+    from openwhisk_tpu.messaging.connector import (decode_batch,
+                                                   decode_message)
+    from openwhisk_tpu.utils.transaction import TransactionId
+    from tools.loadgen import make_schedule
+
+    ring = PartitionRing(n_partitions)
+
+    def ns_for(pid):
+        i = 0
+        while ring.partition_of(f"ns{i}") != pid:
+            i += 1
+        return f"ns{i}"
+
+    async def fenced_echo_fleet(provider, n):
+        """Echo invokers honoring the per-partition fence — the invoker
+        half of the zero-double-execution contract, mirrored from
+        invoker/reactive.py's discard rule."""
+        executed: list = []        # (activation id, partition)
+        fenced = {"discards": 0}
+        feeds, instances = [], []
+        producer = maybe_coalesce(provider.get_producer())
+
+        async def start_one(inst):
+            topic = inst.as_string
+            provider.ensure_topic(topic)
+            consumer = provider.get_consumer(topic, topic)
+            seen_epochs: dict = {}
+            box = {}
+
+            async def handle(payload: bytes):
+                if is_batch_payload(payload):
+                    _kind, msgs = decode_batch(payload)
+                else:
+                    msgs = [decode_message(ActivationMessage.parse,
+                                           payload, "activation")]
+                now = time.time()
+                by_topic = {}
+                for msg in msgs:
+                    if msg.fence_epoch is not None \
+                            and msg.fence_part is not None:
+                        cur = seen_epochs.get(msg.fence_part, -1)
+                        if msg.fence_epoch < cur:
+                            fenced["discards"] += 1
+                            continue  # zombie epoch: no side effect
+                        seen_epochs[msg.fence_part] = msg.fence_epoch
+                    executed.append((msg.activation_id.asString,
+                                     msg.fence_part))
+                    act = WhiskActivation(
+                        EntityPath(str(msg.user.namespace.name)),
+                        msg.action.name, msg.user.subject,
+                        msg.activation_id, now, now,
+                        ActivationResponse.success({"ok": True}),
+                        duration=1)
+                    by_topic.setdefault(
+                        f"completed{msg.root_controller_index.as_string}",
+                        []).append(CombinedCompletionAndResultMessage(
+                            msg.transid, act, inst))
+                for topic2, acks in by_topic.items():
+                    await producer.send_batch(topic2, acks)
+                box["feed"].processed()
+
+            feed = MessageFeed(topic, consumer, 256, handle)
+            box["feed"] = feed
+            feed.start()
+            return feed
+
+        provider.ensure_topic("health")
+        ping_producer = provider.get_producer()
+        for i in range(n):
+            inst = InvokerInstanceId(i, user_memory=MB(8192))
+            instances.append(inst)
+            feeds.append(await start_one(inst))
+            await ping_producer.send("health", PingMessage(inst))
+        stop_ping = asyncio.Event()
+
+        async def pinger():
+            while not stop_ping.is_set():
+                for inst in instances:
+                    await ping_producer.send("health", PingMessage(inst))
+                try:
+                    await asyncio.wait_for(stop_ping.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    pass
+
+        ping_task = asyncio.ensure_future(pinger())
+
+        async def stop():
+            stop_ping.set()
+            await ping_task
+            for f in feeds:
+                await f.stop()
+
+        return executed, fenced, stop
+
+    async def go() -> dict:
+        tmp = tempfile.mkdtemp(prefix="partition-chaos-")
+        provider = MemoryMessagingProvider()
+        executed, fenced, fleet_stop = await fenced_echo_fleet(
+            provider, n_invokers)
+
+        balancers, memberships, journals = {}, {}, {}
+        absorb_stats: list = []
+
+        def wire(i):
+            bal = TpuBalancer(provider, ControllerInstanceId(str(i)),
+                              managed_fraction=1.0, blackbox_fraction=0.0,
+                              kernel="xla", prewarm=False, cluster_size=3)
+            bal.set_partition_mode(ring)
+            journal = PlacementJournal(os.path.join(tmp, f"ctrl{i}"))
+            bal.attach_journal(journal)
+
+            def on_partitions(gained, lost, bal=bal, me=i):
+                for pid, epoch, *_r in lost:
+                    bal.set_partition_leadership(pid, epoch, False)
+                by_prev: dict = {}
+                for pid, epoch, prev in gained:
+                    by_prev.setdefault(prev, []).append((pid, epoch))
+                for prev, items in by_prev.items():
+                    pids = [p for p, _ in items]
+                    if prev is not None:
+                        t0 = time.monotonic()
+                        st = bal.absorb_partitions(
+                            pids, PlacementJournal(
+                                os.path.join(tmp, f"ctrl{prev}")))
+                        st["absorb_ms"] = round(
+                            (time.monotonic() - t0) * 1e3, 1)
+                        st["by"] = me
+                        absorb_stats.append(st)
+                    for pid, epoch in items:
+                        bal.set_partition_leadership(pid, epoch, True)
+
+            m = ControllerMembership(
+                provider, ControllerInstanceId(str(i)), bal,
+                heartbeat_s=0.05, member_timeout_s=0.4, ring=ring,
+                on_partitions=on_partitions,
+                load_hint=lambda b=bal: float(b.total_active_activations))
+            balancers[i], memberships[i], journals[i] = bal, m, journal
+            return bal, m
+
+        for i in range(3):
+            wire(i)
+        for bal in balancers.values():
+            await bal.start()
+        for m in memberships.values():
+            m.start()
+        for _ in range(200):
+            if sum(len(m.owned_partitions)
+                   for m in memberships.values()) == n_partitions \
+                    and all(sum(b._healthy) >= n_invokers
+                            for b in balancers.values()):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("ownership/fleet never converged")
+
+        actions = [_bench_action(f"pc{i}", memory=128) for i in range(4)]
+        idents = {pid: Identity.generate(ns_for(pid))
+                  for pid in range(n_partitions)}
+        dead = set()
+        retries = {"refused": 0}
+        success_t: dict = {pid: [] for pid in range(n_partitions)}
+
+        def msg_for(a, ident, instance):
+            return ActivationMessage(
+                TransactionId(), a.fully_qualified_name, a.rev.rev, ident,
+                ActivationId.generate(), ControllerInstanceId(instance),
+                True, {})
+
+        async def one(i):
+            """Edge-emulating driver: owner-first rank order, bounded
+            retry on REFUSAL ONLY (the 503-safe class); a timeout or a
+            dead upstream mid-flight is a failed sample, never a
+            retry."""
+            pid = i % n_partitions
+            a = actions[i % len(actions)]
+            candidates = [c for c in ring.rank(pid, [0, 1, 2])
+                          if c not in dead] or [0]
+            for attempt, c in enumerate(candidates * 2):
+                if c in dead:
+                    continue
+                bal = balancers[c]
+                try:
+                    promise = await bal.publish(
+                        a, msg_for(a, idents[pid], str(c)))
+                except Exception:  # noqa: BLE001 — refusal (standby /
+                    # unowned partition): pre-state-change, retry-safe
+                    retries["refused"] += 1
+                    await asyncio.sleep(0.02 * (attempt + 1))
+                    continue
+                try:
+                    await asyncio.wait_for(promise, 10)
+                    success_t[pid].append(time.monotonic())
+                    return True
+                except Exception:  # noqa: BLE001 — placed-but-lost: the
+                    return False   # no-retry rule (could double-execute)
+            return False
+
+        offsets = make_schedule(rate, max(1, int(rate * duration)), seed=7)
+        kill_at = duration / 3.0
+        victim = 0
+        t0 = time.monotonic()
+        t_kill = None
+        tasks = []
+        for i, off in enumerate(offsets):
+            now = time.monotonic() - t0
+            if off > now:
+                await asyncio.sleep(off - now)
+            if t_kill is None and off >= kill_at:
+                # SIGKILL semantics, in-process: membership silenced (no
+                # leave), queued-but-undispatched work dropped (futures
+                # never resolve), journal detached with its buffered
+                # tail lost, and the router sees a dead upstream
+                m = memberships[victim]
+                await m._ticker.stop()
+                await m._feed.stop()
+                vb = balancers[victim]
+                if vb._flush_task:
+                    vb._flush_task.cancel()
+                vb._pending.clear()
+                vb._req_ring.clear()
+                vb.journal = None
+                dead.add(victim)
+                t_kill = time.monotonic()
+            tasks.append(asyncio.ensure_future(one(i)))
+        done = await asyncio.gather(*tasks)
+
+        victim_parts = {pid for pid, o
+                        in ring.ownership([0, 1, 2]).items()
+                        if o == victim}
+        survivors_owned = set()
+        t_claimed = None
+        for _ in range(400):
+            survivors_owned = (memberships[1].owned_partitions
+                               | memberships[2].owned_partitions)
+            if survivors_owned >= victim_parts:
+                t_claimed = time.monotonic()
+                break
+            await asyncio.sleep(0.02)
+
+        # post-claim service proof per absorbed partition + downtime
+        t_post = {}
+        for pid in sorted(victim_parts):
+            idx = 10_000 + pid
+            for _ in range(50):
+                if await one(idx):
+                    t_post[pid] = time.monotonic()
+                    break
+                await asyncio.sleep(0.05)
+
+        # zombie salvo: the dead object dispatches with superseded
+        # epochs; the fleet fence must discard every one
+        zombie_aids = []
+        vb = balancers[victim]
+        for pid in sorted(victim_parts)[:4]:
+            a = actions[0]
+            msg = msg_for(a, idents[pid], str(victim))
+            zombie_aids.append(msg.activation_id.asString)
+            try:
+                promise = await vb.publish(a, msg)
+                await asyncio.wait_for(promise, 2)
+            except Exception:  # noqa: BLE001 — expected: fenced acks
+                pass           # never come back
+        await asyncio.sleep(0.3)
+
+        executed_ids = [aid for aid, _pid in executed]
+        # double executions = the SAME activation's side effect landing
+        # twice (duplicate aids). Zombie-salvo rows are FRESH aids the
+        # dead controller dispatched at a superseded epoch: an invoker
+        # that already heard the new epoch for that partition discards
+        # them (fenced), one that hasn't yet runs them ONCE — the
+        # per-invoker fence is eventually-consistent by design, and a
+        # single execution is not a double. Both outcomes are reported;
+        # fenced + executed must account for the whole salvo.
+        dup_execs = len(executed_ids) - len(set(executed_ids))
+        zombie_execs = sum(1 for aid in zombie_aids
+                           if aid in set(executed_ids))
+
+        # journal seq integrity: zero lost / duplicated per journal
+        lost_seqs = dup_seqs = 0
+        journals_checked = 0
+        for i in range(3):
+            d = os.path.join(tmp, f"ctrl{i}")
+            seqs = [int(r["seq"])
+                    for r in PlacementJournal(d).records(0)]
+            if not seqs:
+                continue
+            journals_checked += 1
+            dup_seqs += len(seqs) - len(set(seqs))
+            lost_seqs += (max(seqs) - min(seqs) + 1) - len(set(seqs))
+
+        detection_s = (round(t_claimed - t_kill, 3)
+                       if t_claimed and t_kill else None)
+        downtime_s = None
+        if t_post and t_claimed:
+            downtime_s = round(max(t_post.values()) - t_claimed, 3)
+
+        for i, m in memberships.items():
+            if i != victim:
+                await m.stop()
+        for b in balancers.values():
+            await b.close()
+        await fleet_stop()
+        for j in journals.values():
+            if j is not None:
+                j.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+        return {
+            "downtime_s": downtime_s,
+            "detection_s": detection_s,
+            "double_executions": dup_execs,
+            "absorbed_rate": round(
+                len(survivors_owned & victim_parts)
+                / max(1, len(victim_parts)), 3),
+            "victim_partitions": sorted(victim_parts),
+            "absorbs": absorb_stats,
+            "zombie_salvo": len(zombie_aids),
+            "zombie_executions": zombie_execs,
+            "zombie_fenced_discards": fenced["discards"],
+            "journal_lost_seqs": lost_seqs,
+            "journal_duplicated_seqs": dup_seqs,
+            "journals_checked": journals_checked,
+            "edge_retry_refused": retries["refused"],
+            "burst_completed": int(sum(bool(x) for x in done)),
+            "burst_offered": len(offsets),
+            "offered_rate": rate,
+            "n_partitions": n_partitions,
+            "n_invokers": n_invokers,
+            "excludes_detection_window": True,
+        }
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# partition_chaos failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _backend_unavailable(e: BaseException) -> bool:
     """True for the LAZY backend-init failure mode: the subprocess probe
     passed but the first dispatched op inside the measured run raised
@@ -1985,6 +2367,7 @@ def _run(args) -> Optional[dict]:
     pipeline_speedup = None
     bus_coalesce_speedup = None
     failover_downtime = None
+    partition_chaos = None
     sharded_fleet_sweep = None
     if not args.quick:
         # the new headline first: the open-loop observatory (sustained
@@ -2001,6 +2384,10 @@ def _run(args) -> Optional[dict]:
                                            _bus_coalesce_speedup)
         failover_downtime = timed_rider("_failover_downtime",
                                         _failover_downtime)
+        # ISSUE 15: active/active partitioned control under a mid-burst
+        # kill — downtime, double-executions (must stay 0), absorption
+        partition_chaos = timed_rider("_partition_chaos",
+                                      _partition_chaos)
         waterfall_overhead = timed_rider("_waterfall_overhead",
                                          _waterfall_overhead)
         repair_vs_scan = timed_rider("_repair_vs_scan", _repair_vs_scan)
@@ -2130,6 +2517,8 @@ def _run(args) -> Optional[dict]:
         out["bus_coalesce_speedup"] = bus_coalesce_speedup
     if failover_downtime is not None:
         out["failover_downtime"] = failover_downtime
+    if partition_chaos is not None:
+        out["partition_chaos"] = partition_chaos
     if repair_vs_scan is not None:
         out["repair_vs_scan"] = repair_vs_scan
     if sharded_fleet_sweep is not None:
@@ -2142,7 +2531,7 @@ def _run(args) -> Optional[dict]:
                      waterfall_overhead, e2e_open_loop,
                      repair_vs_scan, pipeline_speedup,
                      bus_coalesce_speedup, failover_downtime,
-                     sharded_fleet_sweep,
+                     partition_chaos, sharded_fleet_sweep,
                      host_profiling_overhead, host_observatory)):
         # a rider lost the device mid-run and re-ran on CPU: say so at the
         # top level so trajectory readers never mistake a CPU number for a

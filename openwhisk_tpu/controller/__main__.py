@@ -83,34 +83,106 @@ def main() -> None:
                 lb = ShardingBalancer(provider, instance, logger=logger,
                                       metrics=logger.metrics,
                                       cluster_size=args.cluster_size)
-            if args.balancer_journal and hasattr(lb, "attach_journal"):
+            # Active/active partitioned controllers (ISSUE 15;
+            # CONFIG_whisk_ha_activeActive + --ha): N simultaneously-
+            # active journaled controllers, each owning a ring partition
+            # set. Each instance writes its OWN journal/snapshot under
+            # the shared storage root (single-writer per journal holds;
+            # peers read each other's tails only at partition absorb).
+            aa_ring = aa_cfg = None
+            if args.ha:
+                from .loadbalancer.partitions import (active_active_config,
+                                                      ring_from_config)
+                aa_cfg = active_active_config()
+                aa_ring = ring_from_config(aa_cfg)
+            journal_dir = args.balancer_journal
+            snap_path = args.balancer_snapshot
+            if aa_ring is not None:
+                import os
+                if journal_dir:
+                    journal_dir = os.path.join(journal_dir,
+                                               f"ctrl{args.instance}")
+                if snap_path:
+                    snap_path = f"{snap_path}.ctrl{args.instance}"
+            if journal_dir and hasattr(lb, "attach_journal"):
                 from .loadbalancer.journal import journal_from_config
-                journal = journal_from_config(args.balancer_journal,
-                                              logger=logger)
+                journal = journal_from_config(journal_dir, logger=logger)
                 if journal is not None:
                     lb.attach_journal(journal)
             ha_on = False
-            if args.ha:
+            if args.ha and aa_ring is None:
                 from .loadbalancer.journal import ha_failover_enabled
                 ha_on = ha_failover_enabled()
                 if not ha_on:
                     logger.warn(None, "--ha requested but "
                                       "CONFIG_whisk_ha_failover_enabled is "
                                       "false; running without failover")
-            if args.balancer_snapshot or journal is not None:
+            if snap_path or journal is not None:
                 from .loadbalancer.checkpoint import (BalancerSnapshotter,
                                                       load_snapshot)
                 if not ha_on:
-                    # non-HA boot: restore right away (HA defers the
-                    # restore to the promotion that claims leadership)
-                    load_snapshot(lb, args.balancer_snapshot or "", logger,
+                    # non-HA boot (and active/active: per-instance
+                    # storage, so our own books restore immediately):
+                    # restore right away (global HA defers the restore
+                    # to the promotion that claims leadership)
+                    load_snapshot(lb, snap_path or "", logger,
                                   cluster_size=args.cluster_size,
                                   journal=journal)
-                if args.balancer_snapshot:
+                if snap_path:
                     snapshotter = BalancerSnapshotter(
-                        lb, args.balancer_snapshot,
+                        lb, snap_path,
                         args.balancer_snapshot_interval, logger,
                         journal=journal).start()
+            if aa_ring is not None:
+                lb.set_partition_mode(aa_ring)
+                lb.spillover_depth = aa_cfg.spillover_depth
+
+                async def on_partitions(gained, lost) -> None:
+                    import json as _json
+                    import os
+                    for pid, epoch, *_rest in lost:
+                        lb.set_partition_leadership(pid, epoch, False)
+                    by_prev: dict = {}
+                    for pid, epoch, prev in gained:
+                        by_prev.setdefault(prev, []).append((pid, epoch))
+                    for prev, items in by_prev.items():
+                        pids = [p for p, _ in items]
+                        if prev is not None and args.balancer_journal \
+                                and hasattr(lb, "absorb_partitions"):
+                            # absorb the previous owner's tail for
+                            # exactly these partitions before placing
+                            # into them. Absorb is journal replay —
+                            # TPU-balancer only (the attach_journal gate
+                            # above); other balancers hand off fence-
+                            # only, and every absorb failure likewise
+                            # degrades to fence-only. DELIBERATELY
+                            # synchronous on the loop: blocking it is
+                            # what gives replay exclusive access to the
+                            # live books (no dispatch interleaves).
+                            # The tradeoff: a missing previous snapshot
+                            # replays the full foreign history, and a
+                            # replay outlasting member_timeout_s can
+                            # flap ownership (peers re-claim) — the
+                            # per-partition fence keeps even that
+                            # double-ownership window execution-safe
+                            from .loadbalancer.journal import \
+                                PlacementJournal
+                            prev_dir = os.path.join(args.balancer_journal,
+                                                    f"ctrl{prev}")
+                            snap_doc = None
+                            if args.balancer_snapshot:
+                                try:
+                                    with open(f"{args.balancer_snapshot}"
+                                              f".ctrl{prev}") as f:
+                                        snap_doc = _json.load(f)
+                                except (OSError, ValueError):
+                                    snap_doc = None
+                            lb.absorb_partitions(
+                                pids, PlacementJournal(prev_dir,
+                                                       logger=logger),
+                                snap_doc=snap_doc, logger=logger)
+                        for pid, epoch in items:
+                            lb.set_partition_leadership(pid, epoch, True)
             if ha_on:
                 from .loadbalancer.checkpoint import load_snapshot
 
@@ -146,6 +218,14 @@ def main() -> None:
             if ha_on:
                 controller.ha_failover = True
                 controller.on_leadership = on_leadership
+            if aa_ring is not None:
+                controller.ha_partition_ring = aa_ring
+                controller.on_partitions = on_partitions
+                if aa_cfg.spillover:
+                    from .loadbalancer.spillover import SpilloverReceiver
+                    controller.spillover_receiver = SpilloverReceiver(
+                        provider, instance, lb, controller.entity_store,
+                        logger=logger, metrics=logger.metrics)
             if args.seed_guest:
                 from ..standalone import guest_identity
                 ident = guest_identity()
@@ -153,8 +233,17 @@ def main() -> None:
                     WhiskAuthRecord(ident.subject, [ident.namespace],
                                     [ident.authkey]))
             await controller.start(host=args.host, port=args.port)
+            if aa_ring is not None and aa_cfg.spillover:
+                # the sender needs the live membership for its least-
+                # loaded ranking, which exists only after start()
+                from .loadbalancer.spillover import SpilloverSender
+                lb.spillover_sink = SpilloverSender(
+                    provider, controller.membership,
+                    metrics=logger.metrics, logger=logger)
             print(f"controller{args.instance} up on :{args.port} "
-                  f"(balancer={args.balancer}, bus={args.bus})", flush=True)
+                  f"(balancer={args.balancer}, bus={args.bus}"
+                  + (f", partitions={aa_ring.n_partitions}"
+                     if aa_ring is not None else "") + ")", flush=True)
             await wait_for_shutdown()
         finally:
             if snapshotter is not None:

@@ -137,6 +137,10 @@ class ControllerApi:
         # tail budget breakdown and slowest-activation exemplars joined to
         # flight-recorder trace ids (auth-gated; host-side reads only)
         r.add_get("/admin/latency/waterfall", self.latency_waterfall)
+        # HA readiness: per-partition role/epoch/replay-state (active/
+        # active), global role (active/standby), journal stall state —
+        # 200 iff this controller is placing for something (auth-gated)
+        r.add_get("/admin/ready", self.admin_ready)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -522,6 +526,51 @@ class ControllerApi:
             # a concurrent window is already armed (or the sampler died
             # between the check above and the arm)
             return _error(409, str(e), request.get("transid"))
+
+    async def admin_ready(self, request):
+        """Ops/chaos readiness probe (ISSUE 15): which placement role this
+        controller holds RIGHT NOW, without scraping /metrics.
+
+        Body: `mode` (single | active_standby | active_active), `ready`,
+        per-partition `{partition, epoch, role, replay}` rows in
+        active/active mode, and the journal's durability state (lag +
+        whether the built-in `journal_stall` alert is firing). Status is
+        200 when this controller is placing for at least one partition
+        (or is the global active / a non-HA single); a standby-for-all
+        answers 503 so load checks and the chaos riders read ownership
+        from the status code alone."""
+        lb = self.c.load_balancer
+        ring = getattr(lb, "partition_ring", None)
+        doc = {}
+        if ring is not None:
+            parts = lb.partitions_json()
+            owned = sum(1 for p in parts if p["role"] == "active")
+            doc.update(mode="active_active", partitions=parts,
+                       owned_partitions=owned,
+                       n_partitions=ring.n_partitions,
+                       ready=owned > 0)
+        elif getattr(lb, "fence_epoch", None) is not None \
+                or getattr(lb, "ha_standby", False):
+            active = not lb.ha_standby
+            doc.update(mode="active_standby",
+                       role="active" if active else "standby",
+                       epoch=lb.fence_epoch or 0, ready=active)
+        else:
+            doc.update(mode="single", ready=True)
+        journal = getattr(lb, "journal", None)
+        jdoc = {"attached": journal is not None}
+        if journal is not None:
+            jdoc["lag_batches"] = journal.lag_batches
+        plane = getattr(lb, "anomaly", None)
+        if plane is not None:
+            jdoc["stall_firing"] = any(
+                name == "journal_stall"
+                for (name, _sev) in plane.engine.firing_counts())
+        doc["journal"] = jdoc
+        mem = self.c.membership
+        if mem is not None:
+            doc["cluster_size"] = mem.cluster_size
+        return web.json_response(doc, status=200 if doc["ready"] else 503)
 
     async def alerts_report(self, request):
         """The alert plane: configured rules, active (pending + firing)

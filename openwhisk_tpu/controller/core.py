@@ -97,6 +97,15 @@ class Controller:
         # active) may be async (promotion restores snapshot+journal).
         self.ha_failover = False
         self.on_leadership = None
+        # Active/active partitioned controllers (loadbalancer/partitions
+        # .py): assemblers set the ring + the partition-transition
+        # callback BEFORE start(). on_partitions(gained, lost) may be
+        # async (a gain absorbs the previous owner's journal tail).
+        # spillover_receiver (loadbalancer/spillover.py) is started/
+        # stopped with the controller when attached.
+        self.ha_partition_ring = None
+        self.on_partitions = None
+        self.spillover_receiver = None
 
     # -- rule status handling (status lives on the trigger doc) ------------
     async def rule_status(self, rule) -> str:
@@ -160,11 +169,25 @@ class Controller:
             # (replaces Akka Cluster events,
             # ShardingContainerPoolBalancer.scala:217-250)
             from .loadbalancer.membership import ControllerMembership
+            lb = self.load_balancer
+
+            def load_hint() -> float:
+                # the spillover plane's least-loaded ranking: in-flight
+                # activations + what is queued for the device
+                return (lb.total_active_activations
+                        + len(getattr(lb, "_pending", ())))
+
             self.membership = ControllerMembership(
                 self.provider, self.instance, self.load_balancer,
                 logger=self.logger, ha=self.ha_failover,
-                on_leadership=self.on_leadership)
+                on_leadership=self.on_leadership,
+                ring=self.ha_partition_ring,
+                on_partitions=self.on_partitions,
+                load_hint=(load_hint if self.ha_partition_ring is not None
+                           else None))
             self.membership.start()
+        if self.spillover_receiver is not None:
+            self.spillover_receiver.start()
         app = self.api.make_app()
         for method, path, handler in self.extra_routes:
             app.router.add_route(method, path, handler)
@@ -184,6 +207,8 @@ class Controller:
             await self._runner.cleanup()
         if self.membership is not None:
             await self.membership.stop()  # sends the graceful leave
+        if self.spillover_receiver is not None:
+            await self.spillover_receiver.stop()
         for resource in self.owned_resources:
             await resource.stop()
         if hasattr(self.entitlement, "close"):

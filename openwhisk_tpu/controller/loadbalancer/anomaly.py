@@ -143,6 +143,12 @@ DEFAULT_RULES: Tuple[AlertRule, ...] = (
               "global"),
     AlertRule("recompile_churn", "recompile_churn_60s", 0.0, 0.0, "warning",
               "global"),
+    # journal writer stall (ISSUE 15): the appended-vs-durable gap stays
+    # above threshold for the window — an fsync device stall. The signal
+    # is fed by TpuBalancer.attach_journal via `extra_signals`; the
+    # firing state also surfaces in GET /admin/ready.
+    AlertRule("journal_stall", "journal_lag_batches", 64.0, 10.0,
+              "critical", "global"),
 )
 
 
@@ -327,6 +333,10 @@ class AnomalyPlane:
                                               anomaly=self.config),
                                   log_size=self.alerts_config.log_size,
                                   logger=logger)
+        #: host-provided global alert signals: name -> zero-arg provider
+        #: returning the current value (None = subject vanished). The
+        #: journal stall watchdog registers `journal_lag_batches` here.
+        self.extra_signals: Dict[str, Callable[[], Optional[float]]] = {}
         # attached collaborators (base-class wiring)
         self._telemetry = None
         self._profiler = None
@@ -491,6 +501,16 @@ class AnomalyPlane:
 
     def _global_signals(self, now: float) -> Dict[str, float]:
         gv: Dict[str, float] = {}
+        # host-provided signals (e.g. journal_lag_batches from
+        # attach_journal): a provider returning None means the subject
+        # vanished — its live alert instances resolve/cancel
+        for name, provider in self.extra_signals.items():
+            try:
+                v = provider()
+            except Exception:  # noqa: BLE001 — a broken provider must not
+                continue       # kill the supervision tick
+            if v is not None:
+                gv[name] = float(v)
         tp = self._telemetry
         if tp is not None and tp.enabled:
             gv["burn_rate_1m"] = tp._burn_rate(FAST_WINDOW_S, now)

@@ -213,6 +213,18 @@ class CommonLoadBalancer(LoadBalancer):
         # behavior: no stamp, always active.
         self.fence_epoch: Optional[int] = None
         self.ha_standby = False
+        # Active/active partitions (loadbalancer/partitions.py): with a
+        # ring attached, placement is fenced PER PARTITION — this
+        # controller refuses namespaces whose partition it does not own
+        # (503, the edge walks to the owner) and stamps (fence_part,
+        # per-partition epoch) on every dispatch. ring=None (the default
+        # and the CONFIG_whisk_ha_activeActive=false path) keeps every
+        # branch below dormant — bit-exact with the single-active path.
+        self.partition_ring = None
+        self.partition_epochs: Dict[int, int] = {}
+        self.owned_partitions: set = set()
+        #: pid -> "replaying" | "ready" (the /admin/ready replay-state)
+        self.partition_replay: Dict[int, str] = {}
         #: batch-shaped completion pipeline (ISSUE 12): a batch wire ack
         #: frame is processed in ONE pass (entries, telemetry, waterfall
         #: folds) instead of N per-ack callback hops. False replays each
@@ -401,6 +413,69 @@ class CommonLoadBalancer(LoadBalancer):
                 f"leadership epoch {epoch}: this controller is now "
                 f"{'ACTIVE' if active else 'standby'}", "LoadBalancer")
 
+    # -- active/active partitions (partitions.py) --------------------------
+    def set_partition_mode(self, ring) -> None:
+        """Attach the namespace partition ring: placement becomes
+        per-partition fenced (class doc). Call before start()."""
+        self.partition_ring = ring
+
+    def partition_of_msg(self, msg: ActivationMessage) -> int:
+        return self.partition_ring.partition_of(
+            str(msg.user.namespace.name))
+
+    def set_partition_leadership(self, pid: int, epoch: int,
+                                 active: bool) -> None:
+        """Adopt one partition's ownership transition (membership.py's
+        per-partition claim/demote). Epochs only move forward."""
+        self.partition_epochs[pid] = max(
+            self.partition_epochs.get(pid, 0), int(epoch))
+        if active:
+            self.owned_partitions.add(pid)
+            self.partition_replay.setdefault(pid, "ready")
+        else:
+            self.owned_partitions.discard(pid)
+            self.partition_replay.pop(pid, None)
+        self.metrics.gauge("loadbalancer_owned_partitions",
+                           len(self.owned_partitions))
+        if self.logger:
+            self.logger.info(
+                TransactionId.LOADBALANCER,
+                f"partition {pid} epoch {epoch}: this controller is now "
+                f"{'ACTIVE' if active else 'standby'} for it",
+                "LoadBalancer")
+
+    def _partition_refusal(self, msg: ActivationMessage,
+                           pid: Optional[int] = None
+                           ) -> Optional["LoadBalancerException"]:
+        """None when this controller may place `msg`; the 503-shaped
+        refusal otherwise. A message already fence-stamped by the current
+        owner of its partition passes even here — that stamp is the
+        spillover credential (spillover.py): the owner explicitly
+        forwarded its overflow, fenced, so replay stays exact. `pid` may
+        be passed pre-computed to spare the hot path a second hash."""
+        if self.partition_ring is None:
+            return None
+        if pid is None:
+            pid = self.partition_of_msg(msg)
+        if pid in self.owned_partitions:
+            return None
+        if (msg.fence_part == pid and msg.fence_epoch is not None
+                and msg.fence_epoch >= self.partition_epochs.get(pid, 0)):
+            return None  # current-epoch spillover from the owner
+        return LoadBalancerException(
+            f"partition {pid} is owned by another controller")
+
+    def partitions_json(self) -> List[dict]:
+        """Per-partition role/epoch/replay-state (the /admin/ready body)."""
+        if self.partition_ring is None:
+            return []
+        return [{"partition": pid,
+                 "epoch": self.partition_epochs.get(pid, 0),
+                 "role": ("active" if pid in self.owned_partitions
+                          else "standby"),
+                 "replay": self.partition_replay.get(pid, "n/a")}
+                for pid in range(self.partition_ring.n_partitions)]
+
     # -- dispatch (ref :175-198) -------------------------------------------
     def prepare_dispatch(self, msg: ActivationMessage,
                          invoker: InvokerInstanceId) -> str:
@@ -408,7 +483,19 @@ class CommonLoadBalancer(LoadBalancer):
         and the batched publish path's task-free send: fence stamping and
         the published counter live HERE so the two paths cannot drift.
         Returns the invoker topic."""
-        if self.fence_epoch is not None:
+        if self.partition_ring is not None:
+            # active/active: stamp (partition, per-partition epoch). A
+            # spilled message arrives already stamped by its origin —
+            # keep the higher of the two epochs (ours can lag the
+            # origin's by one claim announcement)
+            pid = self.partition_of_msg(msg)
+            ep = self.partition_epochs.get(pid)
+            if ep is not None and (msg.fence_part != pid
+                                   or msg.fence_epoch is None
+                                   or ep >= msg.fence_epoch):
+                msg.fence_epoch = ep
+                msg.fence_part = pid
+        elif self.fence_epoch is not None:
             # epoch fencing: invokers discard messages from a superseded
             # epoch, so a zombie active's late batches never double-run
             msg.fence_epoch = self.fence_epoch

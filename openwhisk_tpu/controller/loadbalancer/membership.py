@@ -30,13 +30,32 @@ the tie-break demotes the higher instance within the next heartbeat, and
 fencing makes the overlap harmless for double-execution (equal-epoch
 messages both pass, but each activation id is placed by exactly one
 controller).
+
+Active/active partitions (`ring=PartitionRing(...)`, ISSUE 15): the SAME
+heartbeat stream generalizes from one global claim to a per-partition
+ownership map. Each heartbeat carries `parts` — {partition: epoch} for
+every partition the sender actively owns — plus a `load` hint for the
+spillover plane. Every tick each controller derives the DESIRED owner of
+every partition by rendezvous hashing over its live view (partitions.py)
+and, for each partition it should own but doesn't, claims epoch+1 once
+(a) the boot grace window has passed (an existing claim must be heard
+before it can be superseded) and (b) the current claimant is either dead,
+silent past the member timeout, or simply no longer the rendezvous choice
+(a PLANNED ring rebalance: the join of a new controller moves partitions
+to it by exactly this higher-epoch claim — rebalancing IS the failover
+path, chaos-tested as one). Claim precedence per partition is PR 8's
+rule verbatim: higher epoch wins, ties break to the LOWER instance, and
+a superseded owner demotes that partition the moment it hears the better
+claim. `on_partitions(gained, lost)` fires with the delta — gained
+entries carry the previous owner so the assembler can absorb its journal
+tail for exactly those partitions before placing into them.
 """
 from __future__ import annotations
 
 import asyncio
 import json
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
 from ...messaging.connector import MessageFeed
 from ...utils.scheduler import Scheduler
@@ -56,7 +75,8 @@ class ControllerMembership:
     def __init__(self, messaging_provider, instance, balancer, logger=None,
                  heartbeat_s: float = HEARTBEAT_S,
                  member_timeout_s: float = MEMBER_TIMEOUT_S,
-                 ha: bool = False, on_leadership=None):
+                 ha: bool = False, on_leadership=None,
+                 ring=None, on_partitions=None, load_hint=None):
         self.provider = messaging_provider
         self.instance = instance
         self.balancer = balancer
@@ -79,6 +99,15 @@ class ControllerMembership:
         self._lead_instance: Optional[int] = None
         self._lead_seen = 0.0
         self._is_active = False
+        #: active/active partition ownership (module doc). ring=None is
+        #: the off-switch: no partition state, no heartbeat growth.
+        self.ring = ring
+        self.on_partitions = on_partitions
+        self.load_hint = load_hint
+        self._pepoch: Dict[int, int] = {}     # highest epoch seen, per pid
+        self._powner: Dict[int, Optional[int]] = {}  # claimed owner per pid
+        self._owned: Set[int] = set()
+        self.peer_loads: Dict[int, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -134,17 +163,35 @@ class ControllerMembership:
             return
         if kind == "leave":
             self._last_seen.pop(inst, None)
+            self.peer_loads.pop(inst, None)
             if self.ha and inst == self._lead_instance:
                 # a graceful active departure frees the claim immediately:
                 # age its lease out so the next tick elects without the
                 # full silence timeout
                 self._lead_seen = 0.0
+            # (ring mode needs no extra lease aging here: dropping the
+            # leaver from _last_seen already removes it from the live set
+            # the next _partition_tick derives ownership from)
             self._refold()
         else:
             joined = inst not in self._last_seen
             self._last_seen[inst] = time.monotonic()
             if self.ha and msg.get("active"):
                 self._observe_claim(int(msg.get("epoch", 0)), inst)
+            if self.ring is not None:
+                if "load" in msg:
+                    try:
+                        self.peer_loads[inst] = float(msg["load"])
+                    except (TypeError, ValueError):
+                        pass
+                parts = msg.get("parts")
+                if isinstance(parts, dict):
+                    for pid_s, epoch in parts.items():
+                        try:
+                            self._observe_part_claim(int(pid_s), int(epoch),
+                                                     inst)
+                        except (TypeError, ValueError):
+                            continue
             if joined:
                 self._refold()
 
@@ -153,6 +200,14 @@ class ControllerMembership:
         if self.ha:
             hb["epoch"] = self._lead_epoch
             hb["active"] = self._is_active
+        if self.ring is not None:
+            hb["parts"] = {str(pid): self._pepoch.get(pid, 0)
+                           for pid in sorted(self._owned)}
+            if self.load_hint is not None:
+                try:
+                    hb["load"] = float(self.load_hint())
+                except Exception:  # noqa: BLE001 — a hint, never a blocker
+                    pass
         return json.dumps(hb).encode()
 
     async def _tick(self) -> None:
@@ -180,6 +235,8 @@ class ControllerMembership:
         self._refold()
         if self.ha:
             await self._leadership_tick(now)
+        if self.ring is not None:
+            await self._partition_tick(now)
 
     # -- HA leadership (module doc) ----------------------------------------
     async def _leadership_tick(self, now: float) -> None:
@@ -247,6 +304,100 @@ class ControllerMembership:
                     f"{epoch}; demoting to standby", "Membership")
             self._fire_leadership(False)
         self._export_epoch()
+
+    # -- active/active partition ownership (module doc) --------------------
+    def _observe_part_claim(self, pid: int, epoch: int, inst: int) -> None:
+        """Fold a peer's per-partition ownership assertion. Precedence is
+        the global rule scoped to the partition: higher epoch wins, equal
+        epochs break to the lower instance."""
+        if not (0 <= pid < self.ring.n_partitions):
+            return
+        cur_e = self._pepoch.get(pid, 0)
+        cur_o = self._powner.get(pid)
+        better = (epoch > cur_e
+                  or (epoch == cur_e and (cur_o is None or inst <= cur_o)))
+        if not better:
+            return
+        if inst == cur_o and epoch == cur_e:
+            return  # re-assertion of the claim we already hold folded
+        self._pepoch[pid] = epoch
+        self._powner[pid] = inst
+        if pid in self._owned:
+            # superseded for THIS partition only: stop placing into it
+            # NOW; the epoch bump is already fencing our late batches at
+            # the invokers — the remaining partitions we own are untouched
+            self._owned.discard(pid)
+            if self.logger:
+                self.logger.warn(
+                    TransactionId.LOADBALANCER,
+                    f"partition {pid} ownership superseded by instance "
+                    f"{inst} epoch {epoch}; demoting that partition",
+                    "Membership")
+            self._fire_partitions(gained=[], lost=[(pid, epoch)])
+
+    async def _partition_tick(self, now: float) -> None:
+        """Derive desired ownership from the live view and claim every
+        partition the ring says is ours whose current claim is dead,
+        silent (the _tick prune drops silent members from _last_seen, so
+        the ring stops assigning to them), or held by a live but
+        out-ranked owner (a planned rebalance)."""
+        if now - self._started < self.member_timeout_s:
+            return  # boot grace: hear existing claims before superseding
+        live = {self.instance.instance} | set(self._last_seen)
+        desired = self.ring.ownership(live)
+        me = self.instance.instance
+        gained: List[Tuple[int, int, Optional[int]]] = []
+        for pid, want in desired.items():
+            if want != me or pid in self._owned:
+                continue
+            owner = self._powner.get(pid)
+            # dead/silent owner: a failover. Live but out-ranked owner: a
+            # planned rebalance — the same higher-epoch claim either way.
+            epoch = self._pepoch.get(pid, 0) + 1
+            prev = owner if (owner is not None and owner != me) else None
+            self._pepoch[pid] = epoch
+            self._powner[pid] = me
+            self._owned.add(pid)
+            gained.append((pid, epoch, prev))
+        if gained:
+            if self.logger:
+                self.logger.info(
+                    TransactionId.LOADBALANCER,
+                    f"claiming partitions {[p for p, _, _ in gained]} "
+                    f"(instance {me})", "Membership")
+            # announce immediately — peers demote / stop claiming without
+            # waiting out a heartbeat interval
+            try:
+                await self._producer.send(CONTROLLERS_TOPIC,
+                                          self._heartbeat_msg())
+            except Exception:  # noqa: BLE001 — next tick re-announces
+                pass
+            self._fire_partitions(gained=gained, lost=[])
+
+    def _fire_partitions(self, gained, lost) -> None:
+        metrics = getattr(self.balancer, "metrics", None)
+        if metrics is not None:
+            metrics.gauge("controller_owned_partitions", len(self._owned))
+        cb = self.on_partitions
+        if cb is None:
+            return
+        res = cb(gained, lost)
+        if asyncio.iscoroutine(res):
+            spawn(res, logger=self.logger, name="partition-transition")
+
+    def least_loaded_peer(self) -> Optional[int]:
+        """The spillover target: the live peer with the smallest load
+        hint (None without live peers)."""
+        now = time.monotonic()
+        live = [i for i, ts in self._last_seen.items()
+                if now - ts <= self.member_timeout_s]
+        if not live:
+            return None
+        return min(live, key=lambda i: (self.peer_loads.get(i, 0.0), i))
+
+    @property
+    def owned_partitions(self) -> Set[int]:
+        return set(self._owned)
 
     def _fire_leadership(self, active: bool) -> None:
         cb = self.on_leadership

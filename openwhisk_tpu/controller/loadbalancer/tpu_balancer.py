@@ -61,7 +61,8 @@ from ...messaging.tcp import export_bus_gauges
 from ...utils.hostprof import GLOBAL_HOST_OBSERVATORY
 from ...utils.tracing import export_tracing_gauges, trace_id_of
 from ...utils.waterfall import (STAGE_BATCH_ASSEMBLE, STAGE_DEVICE_DISPATCH,
-                                STAGE_DEVICE_READBACK, STAGE_PUBLISH_ENQUEUE)
+                                STAGE_DEVICE_READBACK, STAGE_PUBLISH_ENQUEUE,
+                                STAGE_SPILL_FORWARD)
 from .base import (HEALTHY, CommonLoadBalancer, InvokerHealth,
                    LoadBalancerException, LoadBalancerThrottleException)
 from .flight_recorder import (BatchRecord, free_slot_histogram,
@@ -664,6 +665,13 @@ class TpuBalancer(CommonLoadBalancer):
         #: on a different device count cold-starts with a logged reason
         #: instead of silently mis-sharding
         self._journal_mesh_stamped = False
+        #: cross-partition spillover (active/active only; spillover.py):
+        #: with a sink attached, publish_many diverts its non-blocking
+        #: overflow past `spillover_depth` pending rows to the
+        #: least-loaded peer instead of deepening the local queue
+        self.spillover_sink = None
+        self.spillover_depth = 256
+        self.spilled_rows = 0
         #: host numpy copy of free_mb from the last readback/state install —
         #: occupancy() serves from this, never the live device buffer.
         #: Installs are sequence-guarded: readback worker threads finish
@@ -1520,6 +1528,12 @@ class TpuBalancer(CommonLoadBalancer):
         err = self._standby_error()
         if err is not None:
             raise err
+        pid = None
+        if self.partition_ring is not None:
+            pid = self.partition_of_msg(msg)
+            err = self._partition_refusal(msg, pid)
+            if err is not None:
+                raise err
         req, slot_key, fqn_str = self._build_row(action, msg)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         # trailing fields feed the flight recorder: enqueue time (queue-age
@@ -1531,6 +1545,12 @@ class TpuBalancer(CommonLoadBalancer):
         entry = (req, fut, slot_key, t_now,
                  aid_str, fqn_str,
                  trace_id_of(msg.trace_context))
+        if pid is not None:
+            # active/active: the row's (partition, epoch) rides the entry
+            # so the dispatch-time journal record carries per-partition
+            # ids + the epoch each row was admitted under (a spilled row
+            # keeps its origin's stamp when that is ahead of ours)
+            entry = entry + ((pid, self._row_epoch(msg, pid)),)
         # waterfall: the activation is now IN the balancer's queue — the
         # delta from here to batch_assemble is pure queueing/window wait
         self.waterfall.stamp(aid_str, STAGE_PUBLISH_ENQUEUE)
@@ -1637,7 +1657,33 @@ class TpuBalancer(CommonLoadBalancer):
                 out.set_exception(type(err)(*err.args))
             return outs
         built: List[tuple] = []
+        ring = self.partition_ring
+        # cross-partition spillover (active/active): with a peered sink
+        # and the pending queue past the depth gate, this batch's
+        # NON-BLOCKING tail forwards to the least-loaded peer instead of
+        # deepening the local queue (the forwarded rows are fenced with
+        # this owner's partition epoch, so the peer's journal replays
+        # them exactly; blocking rows stay local — their client waits on
+        # THIS controller's completion promise)
+        overflow = 0
+        if (ring is not None and self.spillover_sink is not None
+                and self.spillover_sink.has_peer()):
+            overflow = max(0, len(self._pending) + len(pairs)
+                           - self.spillover_depth)
+        spill_rows: List[tuple] = []
         for (action, msg), out in zip(pairs, outs):
+            pid = None
+            if ring is not None:
+                pid = self.partition_of_msg(msg)
+                err = self._partition_refusal(msg, pid)
+                if err is not None:
+                    out.set_exception(err)
+                    continue
+                if overflow > 0 and not msg.blocking \
+                        and pid in self.owned_partitions:
+                    spill_rows.append((action, msg, out, pid))
+                    overflow -= 1
+                    continue
             try:
                 req, slot_key, fqn_str = self._build_row(action, msg)
             except Exception as e:  # noqa: BLE001 — per-row isolation,
@@ -1647,7 +1693,9 @@ class TpuBalancer(CommonLoadBalancer):
                 continue
             built.append((req, loop.create_future(), slot_key,
                           msg.activation_id.asString, msg, action, out,
-                          fqn_str))
+                          fqn_str, pid))
+        if spill_rows:
+            self._spill_forward(spill_rows)
         if not built:
             return outs
         # the serial path notes an arrival only AFTER a successful row
@@ -1665,9 +1713,13 @@ class TpuBalancer(CommonLoadBalancer):
             # two FIFOs cannot desync.
             self._req_ring.push_block(
                 np.asarray([b[0] for b in built], np.int32).T)
-        for req, fut, slot_key, aid, msg, _action, _out, fqn_str in built:
-            self._pending.append((req, fut, slot_key, t_now, aid, fqn_str,
-                                  trace_id_of(msg.trace_context)))
+        for req, fut, slot_key, aid, msg, _action, _out, fqn_str, pid \
+                in built:
+            entry = (req, fut, slot_key, t_now, aid, fqn_str,
+                     trace_id_of(msg.trace_context))
+            self._pending.append(
+                entry if pid is None
+                else entry + ((pid, self._row_epoch(msg, pid)),))
         self.waterfall.stamp_many([b[3] for b in built],
                                   STAGE_PUBLISH_ENQUEUE)
         self.metrics.histogram("loadbalancer_publish_batch_size",
@@ -1694,7 +1746,7 @@ class TpuBalancer(CommonLoadBalancer):
         # bridge makes the readback fan-out read a gone caller as an
         # abandoned publisher (capacity returned per row).
         for b in built:
-            req, fut, slot_key, aid, msg, action, out, _fqn = b
+            req, fut, slot_key, aid, msg, action, out, _fqn, _pid = b
             out.add_done_callback(
                 lambda o, f=fut: (f.cancel() if (o.cancelled()
                                                  and not f.done())
@@ -1795,6 +1847,69 @@ class TpuBalancer(CommonLoadBalancer):
             # caller: fail the row instead
             if not out.done():
                 out.set_exception(e)
+
+    def _row_epoch(self, msg, pid: int) -> int:
+        """The fence epoch a row is admitted under: our view of its
+        partition's epoch, or the origin's stamp when that is ahead (a
+        spilled row whose claim announcement we have not folded yet)."""
+        ep = self.partition_epochs.get(pid, 0)
+        if msg.fence_part == pid and msg.fence_epoch is not None:
+            ep = max(ep, int(msg.fence_epoch))
+        return ep
+
+    def _spill_forward(self, rows: List[tuple]) -> None:
+        """Forward an overflow sub-batch to the spillover sink
+        (spillover.py). Each row is fence-stamped with ITS partition's
+        current epoch BEFORE it leaves — the stamp is both the invoker
+        fence and the peer-side admission credential — and the waterfall
+        stamps the extra hop, then folds the origin-side partial vector
+        (the peer's books own the rest of the row's life). The caller's
+        future resolves to a completed placeholder promise: spillover
+        only takes non-blocking rows, whose promise is never awaited."""
+        wf = self.waterfall
+        loop = asyncio.get_event_loop()
+        pairs = []
+        for action, msg, _out, pid in rows:
+            msg.fence_part = pid
+            msg.fence_epoch = self.partition_epochs.get(pid, 0)
+            pairs.append((action, msg))
+        try:
+            sent = self.spillover_sink.forward(pairs)
+        except Exception as e:  # noqa: BLE001 — a failing forward fails
+            # its rows like a refused publish, never the whole batch —
+            # and is never counted as a forward (no stamp, no counter)
+            for _action, _msg, out, _pid in rows:
+                if not out.done():
+                    out.set_exception(LoadBalancerException(
+                        f"spillover forward failed: {e}"))
+            return
+        # handed to the sink: NOW count the forwards and fold the
+        # origin-side waterfall (an async send that later fails shows up
+        # in loadbalancer_spillover_send_failed, like a lost produce)
+        for _action, msg, _out, _pid in rows:
+            wf.stamp(msg.activation_id.asString, STAGE_SPILL_FORWARD)
+            wf.finish(msg.activation_id.asString)
+        self.spilled_rows += len(rows)
+        self.metrics.counter("loadbalancer_spillover_forwarded", len(rows))
+        for (_action, _msg, out, _pid), row_sent in zip(rows, sent):
+            placeholder: asyncio.Future = loop.create_future()
+            placeholder.set_result(None)
+
+            def _resolve(sf: asyncio.Future, o=out, p=placeholder) -> None:
+                exc = None if sf.cancelled() else sf.exception()
+                if exc is not None:
+                    self.metrics.counter(
+                        "loadbalancer_spillover_send_failed")
+                if o.done():
+                    return
+                if sf.cancelled():
+                    o.cancel()
+                elif exc is not None:
+                    o.set_exception(exc)
+                else:
+                    o.set_result(p)
+
+            row_sent.add_done_callback(_resolve)
 
     async def _send_then_resolve(self, invoker, msg, out: asyncio.Future,
                                  promise) -> None:
@@ -1941,10 +2056,17 @@ class TpuBalancer(CommonLoadBalancer):
     def attach_journal(self, journal) -> None:
         """Adopt a PlacementJournal. Appends start from the max of the
         balancer's own seq and what the log already holds, so a restarted
-        active never reuses a sequence number."""
+        active never reuses a sequence number. Also registers the
+        journal's durability lag as an alert signal: the built-in
+        `journal_stall` rule (anomaly.py) fires when the lag stays above
+        its threshold for its window — an fsync device stall — and
+        /admin/ready surfaces the firing state."""
         self.journal = journal
         if journal is not None:
             self._journal_seq = max(self._journal_seq, journal.last_seq())
+            self.anomaly.extra_signals["journal_lag_batches"] = (
+                lambda: float(self.journal.lag_batches)
+                if self.journal is not None else None)
 
     def _journal_live(self) -> bool:
         return (self.journal is not None and not self._journal_mute
@@ -1981,7 +2103,8 @@ class TpuBalancer(CommonLoadBalancer):
         return rec.get("seq", 0)
 
     def replay_journal(self, records, logger=None,
-                       from_seq: Optional[int] = None) -> dict:
+                       from_seq: Optional[int] = None,
+                       parts_filter=None, foreign: bool = False) -> dict:
         """Deterministically re-execute a journal tail on top of the
         current (snapshot-restored) state. Batch records re-run the SAME
         schedule/release kernels the active used (non-donated replay
@@ -2004,12 +2127,27 @@ class TpuBalancer(CommonLoadBalancer):
         any mismatch — journal written at a different shard count, or a
         single-device journal replayed on a mesh (and vice versa) —
         COLD-STARTS with a logged reason instead of silently
-        mis-sharding (`skipped: "mesh_topology"`)."""
+        mis-sharding (`skipped: "mesh_topology"`).
+
+        Active/active (ISSUE 15): `parts_filter` restricts the replay to
+        records whose `parts` intersect the given partition set — the
+        HANDOFF path, where the new owner of a partition set absorbs the
+        previous owner's tail and nothing else (structural records —
+        registration/growth/cluster — are the previous owner's OWN
+        topology and are skipped under a filter). `foreign=True` marks
+        the tail as another controller's journal: its seqs live in that
+        journal's numbering, so this balancer's own `_journal_seq` never
+        moves, and a topology mismatch SKIPS the absorb (logged) instead
+        of cold-starting the survivor's live books. Records carrying a
+        `pe` (per-partition epoch) map are additionally dropped PER
+        PARTITION: a record whose every overlapping partition was
+        superseded at-or-before its seq is a zombie owner's late flush."""
         log = logger or self.logger
-        if from_seq is not None:
+        if from_seq is not None and not foreign:
             self._journal_seq = int(from_seq)
         stats = {"replayed": 0, "batches": 0, "parity_mismatches": 0,
-                 "from_seq": self._journal_seq}
+                 "from_seq": (int(from_seq) if from_seq is not None
+                              else self._journal_seq)}
         self.profiler.expect("snapshot_restore")
         recs = [r for r in records]
         # stale-epoch filter: a demoted active's already-popped write batch
@@ -2017,15 +2155,61 @@ class TpuBalancer(CommonLoadBalancer):
         # any record whose epoch is superseded at-or-before its seq was
         # never part of the promoted active's state and must not replay
         first_seq: Dict[int, int] = {}
+        #: per-partition variant of the same bound: (pid, epoch) -> first
+        #: seq observed carrying it (records with a `pe` map)
+        pfirst_seq: Dict[tuple, int] = {}
         for r in recs:
             e, s = int(r.get("epoch", 0)), int(r.get("seq", 0))
             first_seq[e] = min(first_seq.get(e, s), s)
+            for pid_s, pe in (r.get("pe") or {}).items():
+                key = (int(pid_s), int(pe))
+                pfirst_seq[key] = min(pfirst_seq.get(key, s), s)
         bounds = sorted(first_seq.items())
+        pbounds: Dict[int, list] = {}
+        for (pid, e), s in sorted(pfirst_seq.items()):
+            pbounds.setdefault(pid, []).append((e, s))
+
+        def _fresh_for(pid: int, e: int, s: int) -> bool:
+            return not any(e2 > e and s2 <= s
+                           for e2, s2 in pbounds.get(pid, ()))
 
         def _fresh(r: dict) -> bool:
             e, s = int(r.get("epoch", 0)), int(r.get("seq", 0))
-            return not any(e2 > e and s2 <= s for e2, s2 in bounds)
+            if any(e2 > e and s2 <= s for e2, s2 in bounds):
+                return False
+            pe = r.get("pe")
+            if not pe:
+                return True
+            # fresh while ANY overlapping partition is fresh — a batch
+            # mixing a stale and a live partition still owes the live
+            # partition its holds (the stale rows are epsilon over-hold,
+            # self-healed by forced timeouts like every replay over-hold)
+            pids = ((int(p) for p in pe)
+                    if parts_filter is None
+                    else (int(p) for p in pe if int(p) in parts_filter))
+            return any(_fresh_for(p, int(pe[str(p)]), s) for p in pids)
 
+        if parts_filter is not None:
+            parts_filter = set(int(p) for p in parts_filter)
+            kept = []
+            kept_seqs = set()
+            for r in recs:
+                t = r.get("t")
+                if t == "batch":
+                    if parts_filter & set(int(p) for p in
+                                          r.get("parts") or ()):
+                        kept.append(r)
+                        kept_seqs.add(int(r.get("seq", 0)))
+                elif t == "ack":
+                    # an ack applies through its dispatch-time batch
+                    # record: absorbed iff that batch was
+                    if int(r.get("for", 0)) in kept_seqs:
+                        kept.append(r)
+                # everything else (reg/grow/slots/cluster/reinit/fold/
+                # mesh): the previous owner's own topology and idle
+                # bookkeeping — never part of a partition handoff
+            stats["filtered_out"] = len(recs) - len(kept)
+            recs = kept
         n_all = len(recs)
         recs = [r for r in recs if _fresh(r)]
         stats["stale_epoch_dropped"] = n_all - len(recs)
@@ -2035,6 +2219,9 @@ class TpuBalancer(CommonLoadBalancer):
                 if r.get("t") == "ack" and "for" in r}
         replay_step = make_fused_step_packed(self._release_fn, self._sched_fn)
         replay_release = make_release_packed(self._release_fn)
+        # foreign tails run on a LOCAL cursor in the dead owner's seq
+        # space; our own journal numbering is untouched
+        cursor = (int(from_seq or 0) if foreign else self._journal_seq)
         self._journal_mute = True
         try:
             for rec in recs:
@@ -2043,14 +2230,31 @@ class TpuBalancer(CommonLoadBalancer):
                 if t == "ack":
                     # already applied through its batch record; still claim
                     # the seq so the promoted active never reuses it
-                    self._journal_seq = max(self._journal_seq, seq)
+                    cursor = max(cursor, seq)
+                    if not foreign:
+                        self._journal_seq = cursor
                     continue
-                if seq <= self._journal_seq:
+                if seq <= cursor:
                     continue
                 if t in ("batch", "mesh"):
                     got = int(rec.get("S" if t == "batch" else "n_shards",
                                       1))
                     if got != self.n_shards:
+                        if foreign:
+                            # NEVER cold-start a live survivor's books
+                            # over an absorbed tail: skip the absorb, say
+                            # so — the epoch bump (which already
+                            # happened) is the correctness guarantee;
+                            # the un-replayed holds self-heal
+                            if log:
+                                log.warn(None, "absorbed journal tail was "
+                                               f"written at {got} fleet "
+                                               f"shard(s), this balancer "
+                                               f"runs {self.n_shards}; "
+                                               "skipping the absorb "
+                                               "replay", "TpuBalancer")
+                            stats["skipped"] = "mesh_topology"
+                            break
                         return self._topology_coldstart(stats, recs, got,
                                                         log)
                 if t == "mesh":
@@ -2076,16 +2280,61 @@ class TpuBalancer(CommonLoadBalancer):
                     log.warn(None, f"journal record type {t!r} unknown "
                                    "(newer writer?); skipped", "TpuBalancer")
                 stats["replayed"] += 1
-                self._journal_seq = max(self._journal_seq, seq)
+                cursor = max(cursor, seq)
+                if not foreign:
+                    self._journal_seq = cursor
         finally:
             self._journal_mute = False
         self._set_books_now(np.asarray(self.state.free_mb))
-        stats["last_seq"] = self._journal_seq
+        stats["last_seq"] = cursor
         if stats["parity_mismatches"] and log:
             log.warn(None, f"journal replay re-derived "
                            f"{stats['parity_mismatches']} decisions "
                            "differently than the recorded readback (kernel "
                            "knobs changed across the restart?)", "TpuBalancer")
+        return stats
+
+    def absorb_partitions(self, pids, journal, snap_doc=None,
+                          logger=None) -> dict:
+        """Partition handoff, absorb side (ISSUE 15): replay the PREVIOUS
+        owner's journal tail — filtered to exactly the partitions this
+        controller just claimed — through the same kernels, on top of the
+        live books. This is PR 8's promote-and-replay scoped per
+        partition: the dead (or rebalanced-away) owner's post-snapshot
+        in-flight holds for these partitions land on the new owner's
+        books conservatively (un-acked rows self-heal via forced
+        timeouts), per-partition stale epochs drop, and the previous
+        owner's structural records never touch our topology. The
+        absorbed tail's seqs stay in the previous owner's numbering
+        (`foreign`), so our own journal order is untouched. The epoch
+        bump that fences the previous owner happened at claim time
+        (set_partition_leadership) — this replay is books-accuracy, the
+        fence is the zero-double-execution guarantee.
+
+        Every failure path degrades to skipped-absorb with the fence
+        still in place; never an abort."""
+        log = logger or self.logger
+        pids = set(int(p) for p in pids)
+        for pid in pids:
+            self.partition_replay[pid] = "replaying"
+        from_seq = int((snap_doc or {}).get("journal_seq", 0))
+        stats = {"absorbed_partitions": sorted(pids), "replayed": 0}
+        try:
+            stats = self.replay_journal(journal.records(from_seq),
+                                        logger=log, from_seq=from_seq,
+                                        parts_filter=pids, foreign=True)
+            stats["absorbed_partitions"] = sorted(pids)
+        except Exception as e:  # noqa: BLE001 — degrade, never abort: the
+            # claim's epoch bump already fences the previous owner
+            stats["skipped"] = f"absorb_error: {e!r}"
+            if log:
+                log.warn(None, f"partition absorb replay failed ({e!r}); "
+                               "continuing with the fence only",
+                         "TpuBalancer")
+        finally:
+            for pid in pids:
+                self.partition_replay[pid] = "ready"
+        self.metrics.counter("loadbalancer_partitions_absorbed", len(pids))
         return stats
 
     def _topology_coldstart(self, stats: dict, recs: list, got: int,
@@ -2603,6 +2852,20 @@ class TpuBalancer(CommonLoadBalancer):
                 "H": int(health_np.shape[1]), "B": bp,
                 "rows": rows, "b": b, "buf": encode_array(buf),
                 "aids": [e[4] for e in batch]}
+            if self.partition_ring is not None:
+                # active/active: the record carries its rows' ring
+                # partitions plus the epoch each was admitted under, so
+                # a handoff replays EXACTLY the partitions the new owner
+                # absorbed and drops per-partition stale epochs
+                # (replay_journal parts_filter). Off-mode records carry
+                # neither key — the wire format is unchanged.
+                pe: Dict[str, int] = {}
+                for e in batch:
+                    if len(e) > 7:
+                        p, ep = e[7]
+                        pe[str(p)] = max(pe.get(str(p), 0), int(ep))
+                jrec["parts"] = sorted(int(p) for p in pe)
+                jrec["pe"] = pe
             if self.mesh is not None:
                 # shard count travels on EVERY batch record (the one-shot
                 # `mesh` header can be pruned away with its snapshot):
@@ -2849,8 +3112,8 @@ class TpuBalancer(CommonLoadBalancer):
         if file:
             n_reg = len(self._registry)
             decisions = rec.decisions
-            for (req, fut, slot_key, t_enq, aid, act, _tid), ci, f, thr in \
-                    zip(batch, chosen_np, forced_np, throttled_np):
+            for (req, fut, slot_key, t_enq, aid, act, _tid, *_), ci, f, thr \
+                    in zip(batch, chosen_np, forced_np, throttled_np):
                 ci = int(ci)
                 name = (self._registry[ci].as_string
                         if 0 <= ci < n_reg else None)

@@ -25,6 +25,9 @@ def main() -> None:
                         help="base domain for vanity web-action URLs")
     parser.add_argument("--tls-cert", default=None)
     parser.add_argument("--tls-key", default=None)
+    parser.add_argument("--retry-attempts", type=int, default=0,
+                        help="bounded upstream attempts per request "
+                             "(0 = auto: two passes over the pool, min 4)")
     args = parser.parse_args()
 
     if bool(args.tls_cert) != bool(args.tls_key):
@@ -36,6 +39,18 @@ def main() -> None:
 
     async def run():
         kwargs = {"domain": args.domain} if args.domain else {}
+        if args.retry_attempts:
+            kwargs["retry_attempts"] = args.retry_attempts
+        # active/active partitioned controllers: route owner-first by the
+        # same ring the controllers agree on (CONFIG_whisk_ha_activeActive;
+        # --controllers must be listed in instance order). utils path, NOT
+        # the loadbalancer re-export: the edge must stay jax-free
+        from ..utils.partitions import ring_from_config
+        ring = ring_from_config()
+        if ring is not None:
+            kwargs["ring"] = ring
+            print(f"edge ring routing: {ring.n_partitions} partitions over "
+                  f"{len(args.controllers)} controllers", flush=True)
         proxy = EdgeProxy.for_controllers(args.controllers, **kwargs)
         await proxy.start(host=args.host, port=args.port, ssl_context=ssl_ctx)
         scheme = "https" if ssl_ctx else "http"

@@ -14,10 +14,26 @@ Behavior ported from ansible/roles/nginx/templates/nginx.conf.j2:
 On top of that it serves API-gateway routes (reference: external gateway +
 core/routemgmt): requests matching a registered (basePath, relPath, verb)
 are forwarded to the backing web action.
+
+Active/active partitioned controllers (ISSUE 15): with a `ring`
+(controller/loadbalancer/partitions.py — upstream list order must match
+controller instance numbering), requests whose path names an explicit
+namespace are routed OWNER-FIRST: the upstream order is the partition's
+rendezvous ranking, so the first hop is the controller that owns the
+namespace's partition, and a 503 (an owner mid-handoff, or a stale
+ranking during a rebalance) walks to the next candidate. Retries are
+BOUNDED with jittered exponential backoff (`retry_attempts`,
+`retry_backoff_ms`) on 503/connect-error — the first pass over the pool
+walks sleep-free (the pre-existing behavior), then backoff bridges the
+membership detection window during a failover instead of burning the
+attempt budget in the first milliseconds — and every retry counts into
+`retry_total[reason]` (the `edge_retry_total{reason}` family) so chaos
+riders assert retries stayed bounded.
 """
 from __future__ import annotations
 
 import asyncio
+import random
 import secrets
 import time
 from dataclasses import dataclass, field
@@ -50,7 +66,23 @@ class EdgeProxy:
     fail_timeout: float = 60.0
     read_timeout: float = 75.0  # nginx proxy_read_timeout 75s
     route_matcher: Optional[Callable[[str, str], Awaitable[Optional[Dict]]]] = None
+    #: active/active: PartitionRing for owner-first routing (module doc);
+    #: None keeps the round-robin order bit-exactly
+    ring: Optional[object] = None
+    #: total upstream attempts per request; 0 = auto (two passes over the
+    #: pool, min 4 — one pass is today's behavior, the second rides the
+    #: backoff through a failover's detection window)
+    retry_attempts: int = 0
+    retry_backoff_ms: float = 25.0
+    retry_backoff_max_ms: float = 400.0
+    #: retries performed, by reason ("http_503" | "connect" | "read") —
+    #: the edge_retry_total{reason} counter family
+    retry_total: Dict[str, int] = field(default_factory=dict)
     _rr: int = 0
+    #: partition -> upstream-index ranking, computed once per pid: the
+    #: member set here is always the fixed range(len(upstreams)), so the
+    #: per-request rendezvous hash+sort is pure repeated work
+    _rank_cache: Dict[int, List[int]] = field(default_factory=dict)
     _session: Optional[aiohttp.ClientSession] = None
     _runner: Optional[web.AppRunner] = None
     extra_denied_paths: tuple = ("/metrics",)
@@ -129,7 +161,19 @@ class EdgeProxy:
         suffix = target + (("?" + qs) if qs else "")
         last_error: Optional[Exception] = None
         last_503: Optional[web.Response] = None
-        for upstream in self._pick_order():
+        order = self._pick_order(self._path_namespace(request.path))
+        attempts = self.retry_attempts or max(4, 2 * len(order))
+        for attempt in range(attempts):
+            if attempt >= len(order):
+                # past the first pass over the pool. The first walk stays
+                # sleep-free (a standby's 503 forwards to the active with
+                # zero added latency, exactly the pre-retry behavior);
+                # later passes back off with full jitter so a failover's
+                # synchronized retry wave doesn't hammer the surviving
+                # controllers in lockstep — the backoff is what bridges
+                # the membership detection window
+                await asyncio.sleep(self._backoff_s(attempt - len(order) + 1))
+            upstream = order[attempt % len(order)]
             try:
                 async with self._session.request(
                         request.method, upstream.url + suffix,
@@ -143,13 +187,16 @@ class EdgeProxy:
                     out_headers[TRANSACTION_HEADER] = transid
                     if resp.status == 503:
                         # a 503 is emitted BEFORE any state change (an HA
-                        # standby refusing placement, or no usable fleet):
-                        # trying the next upstream is safe for any method
-                        # (nginx `proxy_next_upstream http_503`). No
-                        # blacklist — a standby answers everything else
-                        # fine and becomes active without re-resolving.
+                        # standby refusing placement, a partition owned
+                        # elsewhere, or no usable fleet): trying the next
+                        # upstream is safe for any method (nginx
+                        # `proxy_next_upstream http_503`). No blacklist —
+                        # a standby answers everything else fine and
+                        # becomes active without re-resolving.
                         last_503 = web.Response(status=503, body=payload,
                                                 headers=out_headers)
+                        if attempt + 1 < attempts:
+                            self._count_retry("http_503")
                         continue
                     return web.Response(status=resp.status, body=payload,
                                         headers=out_headers)
@@ -160,6 +207,8 @@ class EdgeProxy:
                 upstream.fails += 1
                 upstream.fail_until = time.monotonic() + self.fail_timeout
                 last_error = e
+                if attempt + 1 < attempts:
+                    self._count_retry("connect")
             except (aiohttp.ClientConnectionError, asyncio.TimeoutError):
                 # the request may already be executing upstream (e.g. a slow
                 # blocking invoke hit read_timeout): do NOT re-send non-
@@ -167,19 +216,59 @@ class EdgeProxy:
                 # them), and a slow request is no reason to blacklist
                 if request.method in ("GET", "HEAD", "OPTIONS"):
                     last_error = RuntimeError("upstream read failed")
+                    if attempt + 1 < attempts:
+                        self._count_retry("read")
                     continue
                 return web.Response(status=504, text="upstream timeout")
         if last_503 is not None:
-            # every upstream said 503: surface the real refusal (body and
+            # every attempt said 503: surface the real refusal (body and
             # all) instead of a generic 502
             return last_503
         return web.Response(status=502, text=f"no upstream available: {last_error}")
 
-    def _pick_order(self) -> List[Upstream]:
+    def _count_retry(self, reason: str) -> None:
+        self.retry_total[reason] = self.retry_total.get(reason, 0) + 1
+
+    def _backoff_s(self, attempt: int) -> float:
+        """Full-jitter exponential backoff for retry `attempt` (>= 1)."""
+        cap = min(self.retry_backoff_max_ms,
+                  self.retry_backoff_ms * (2 ** (attempt - 1)))
+        return random.uniform(0.0, cap) / 1e3
+
+    def _path_namespace(self, path: str) -> Optional[str]:
+        """The explicit namespace in an API path, for ring routing
+        (`/api/v1/namespaces/{ns}/...`). `_` resolves to the caller's
+        subject namespace upstream — unknowable here, so it falls back to
+        round-robin (the bounded 503 retry still finds the owner).
+
+        The hint is approximate by design: controllers partition by the
+        AUTHENTICATED identity's namespace (tenant affinity — the edge
+        has no auth store to resolve a key to one), which equals the
+        path namespace for ordinary self-namespace invokes but not for
+        cross-namespace shared-package calls. A miss costs extra
+        sleep-free 503 hops on the first pass over the pool — the
+        owner-side refusal stays the correctness gate either way."""
+        prefix = "/api/v1/namespaces/"
+        if not path.startswith(prefix):
+            return None
+        ns = path[len(prefix):].split("/", 1)[0]
+        return ns if ns and ns != "_" else None
+
+    def _pick_order(self, namespace: Optional[str] = None) -> List[Upstream]:
         """Round-robin over usable upstreams; all down → try everyone anyway
-        (nginx resurrects a dead pool rather than hard-failing)."""
+        (nginx resurrects a dead pool rather than hard-failing). With a
+        ring and an explicit namespace, the order is the partition's
+        rendezvous ranking instead — the first hop is the owner."""
         n = len(self.upstreams)
-        order = [self.upstreams[(self._rr + i) % n] for i in range(n)]
-        self._rr = (self._rr + 1) % n
+        if self.ring is not None and namespace is not None:
+            pid = self.ring.partition_of(namespace)
+            ranked = self._rank_cache.get(pid)
+            if ranked is None:
+                ranked = self._rank_cache[pid] = self.ring.rank(
+                    pid, range(n))
+            order = [self.upstreams[i] for i in ranked]
+        else:
+            order = [self.upstreams[(self._rr + i) % n] for i in range(n)]
+            self._rr = (self._rr + 1) % n
         usable = [u for u in order if u.usable()]
         return usable or order

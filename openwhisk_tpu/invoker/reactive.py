@@ -79,6 +79,10 @@ class InvokerReactive:
         #: no-double-execution half of the failover contract). -1 until the
         #: first fenced message; unfenced messages never participate.
         self._max_fence_epoch = -1
+        #: active/active partitions: the same discard rule scoped per ring
+        #: partition (messages carrying fence_part) — partition P's epoch
+        #: bump must not fence partition Q's in-flight owner
+        self._fence_epochs: dict = {}
         self.fenced_discards = 0
 
     # -- capacity: maxPeek mirrors ref :172-173 -----------------------------
@@ -228,7 +232,12 @@ class InvokerReactive:
         """The per-activation body shared by the serial and batch pickup
         paths (the pickup stage is already stamped by the caller)."""
         if msg.fence_epoch is not None:
-            if msg.fence_epoch < self._max_fence_epoch:
+            if msg.fence_part is not None:
+                # active/active: one max epoch PER PARTITION
+                current = self._fence_epochs.get(msg.fence_part, -1)
+            else:
+                current = self._max_fence_epoch
+            if msg.fence_epoch < current:
                 # a superseded epoch's late batch: the current active (or
                 # its own retry path) owns this work now — running it here
                 # would double-place
@@ -236,14 +245,19 @@ class InvokerReactive:
                 if self.metrics is not None:
                     self.metrics.counter("invoker_fenced_discards")
                 if self.logger:
+                    part = ("" if msg.fence_part is None
+                            else f" partition {msg.fence_part}")
                     self.logger.warn(
                         msg.transid,
                         f"discarding activation {msg.activation_id} from "
-                        f"fenced epoch {msg.fence_epoch} (current "
-                        f"{self._max_fence_epoch})", "InvokerReactive")
+                        f"fenced epoch {msg.fence_epoch}{part} (current "
+                        f"{current})", "InvokerReactive")
                 release()
                 return
-            self._max_fence_epoch = msg.fence_epoch
+            if msg.fence_part is not None:
+                self._fence_epochs[msg.fence_part] = msg.fence_epoch
+            else:
+                self._max_fence_epoch = msg.fence_epoch
         from ..utils.tracing import GLOBAL_TRACER
         # (the waterfall invoker_pickup stamp happened at decode time —
         # single frames stamp one id, batch frames stamp_many; in

@@ -151,6 +151,7 @@ class ActivationBatchMessage(Message):
         trace: Dict[str, dict] = {}
         init: Dict[str, dict] = {}
         fences: Dict[str, int] = {}
+        fparts: Dict[str, int] = {}
         for row, m in enumerate(self.msgs):
             ids.append(m.activation_id.asString)
             # identity dedup keys on the subject+namespace-uuid pair (the
@@ -174,6 +175,8 @@ class ActivationBatchMessage(Message):
                 init[str(row)] = m.init_args
             if m.fence_epoch is not None:
                 fences[str(row)] = m.fence_epoch
+            if m.fence_part is not None:
+                fparts[str(row)] = m.fence_part
         out = {
             "whiskBatch": KIND_ACTIVATION,
             "users": users.values,
@@ -197,6 +200,14 @@ class ActivationBatchMessage(Message):
                 out["fence"] = vals.pop()
             else:
                 out["fences"] = fences
+        if fparts:
+            # active/active: per-row partition ids (a batch freely mixes
+            # namespaces, so partitions rarely collapse to one scalar)
+            vals = set(fparts.values())
+            if len(vals) == 1 and len(fparts) == len(self.msgs):
+                out["fpart"] = vals.pop()
+            else:
+                out["fparts"] = fparts
         return out
 
     @staticmethod
@@ -220,6 +231,8 @@ class ActivationBatchMessage(Message):
         init = j.get("init") or {}
         fence = j.get("fence")
         fences = j.get("fences") or {}
+        fpart = j.get("fpart")
+        fparts = j.get("fparts") or {}
         out: List[ActivationMessage] = []
         for row, (aid, u, a, c, tx, bl, args) in enumerate(zip(
                 j["ids"], j["u"], j["a"], j["c"], j["tx"], j["bl"],
@@ -233,7 +246,8 @@ class ActivationBatchMessage(Message):
                 init.get(key) or {},
                 ActivationId(row_cause) if row_cause else None,
                 trace.get(key),
-                fence if fence is not None else fences.get(key)))
+                fence if fence is not None else fences.get(key),
+                fpart if fpart is not None else fparts.get(key)))
         return out
 
 

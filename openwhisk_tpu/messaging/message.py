@@ -40,7 +40,8 @@ class ActivationMessage(Message):
                  init_args: Optional[Dict[str, Any]] = None,
                  cause: Optional[ActivationId] = None,
                  trace_context: Optional[Dict[str, str]] = None,
-                 fence_epoch: Optional[int] = None):
+                 fence_epoch: Optional[int] = None,
+                 fence_part: Optional[int] = None):
         self.transid = transid
         self.action = action
         self.revision = revision
@@ -58,6 +59,12 @@ class ActivationMessage(Message):
         #: active's late batches never double-run. None (the default, and
         #: the whole non-HA path) means unfenced.
         self.fence_epoch = fence_epoch
+        #: Active/active partitions (loadbalancer/partitions.py): the ring
+        #: partition this activation's namespace hashes to. When set, the
+        #: fence_epoch above is PER PARTITION — invokers keep one max
+        #: epoch per partition instead of one global. None everywhere
+        #: outside active/active mode (wire stays byte-identical).
+        self.fence_part = fence_part
 
     def to_json(self) -> dict:
         out = {
@@ -77,6 +84,8 @@ class ActivationMessage(Message):
             # only on the wire when fencing is live: the non-HA message
             # stays byte-identical to the pre-HA format
             out["fenceEpoch"] = self.fence_epoch
+        if self.fence_part is not None:
+            out["fencePart"] = self.fence_part
         return out
 
     @classmethod
@@ -94,6 +103,7 @@ class ActivationMessage(Message):
             ActivationId(j["cause"]) if j.get("cause") else None,
             j.get("traceContext"),
             j.get("fenceEpoch"),
+            j.get("fencePart"),
         )
 
     @classmethod

@@ -58,6 +58,9 @@ STAGES = (
     "api_accept",         # request routed + parsed at the REST handler
     "entitle",            # entitlement (rights) check passed
     "throttle",           # rate/concurrency throttle passed
+    "spill_forward",      # overflow row forwarded to a peer controller
+                          # (active/active spillover; origin-side terminal
+                          # stage — the peer's books own the rest)
     "publish_enqueue",    # balancer accepted the activation into its queue
     "batch_assemble",     # micro-batch packed host-side (TPU balancer)
     "device_dispatch",    # device program dispatched
@@ -69,7 +72,8 @@ STAGES = (
     "completion_ack",     # controller processed the completion ack
     "record_write",       # activation record persisted (may race the ack)
 )
-(STAGE_API_ACCEPT, STAGE_ENTITLE, STAGE_THROTTLE, STAGE_PUBLISH_ENQUEUE,
+(STAGE_API_ACCEPT, STAGE_ENTITLE, STAGE_THROTTLE, STAGE_SPILL_FORWARD,
+ STAGE_PUBLISH_ENQUEUE,
  STAGE_BATCH_ASSEMBLE, STAGE_DEVICE_DISPATCH, STAGE_DEVICE_READBACK,
  STAGE_PRODUCE, STAGE_INVOKER_PICKUP, STAGE_CONTAINER_ACQUIRE, STAGE_RUN,
  STAGE_COMPLETION_ACK, STAGE_RECORD_WRITE) = range(len(STAGES))

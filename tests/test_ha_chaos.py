@@ -709,6 +709,196 @@ class TestJournaledFailover:
 
 
 @pytest.mark.slow
+class TestActiveActivePartitionChaos:
+    def test_kill_one_of_three_actives_survivors_absorb_partitions(
+            self, tmp_path):
+        """ISSUE 15 tentpole, chaos half: THREE active/active partitioned
+        controllers (CONFIG_whisk_ha_activeActive + --ha) share the
+        journal/snapshot storage root; open-loop no-retry traffic over
+        several namespaces runs through the edge while one active is
+        SIGKILLed mid-burst. The survivors must claim its partitions
+        (higher epochs), absorb its journal tail, and keep serving every
+        namespace — with ZERO double-executed side effects and bounded
+        downtime. Per-partition ownership is probed over /admin/ready."""
+        effects = tmp_path / "effects"
+        effects.mkdir()
+        snap = str(tmp_path / "aa.snap")
+        jdir = str(tmp_path / "wal")
+        side_code = (
+            "import os, uuid\n"
+            "def main(a):\n"
+            "    p = os.path.join(a['dir'], '%s-%s' % (a['n'],"
+            " uuid.uuid4().hex))\n"
+            "    open(p, 'w').close()\n"
+            "    return {'n': a['n']}\n")
+        cluster = Cluster(tmp_path, n_controllers=3, edge=True,
+                          balancer="tpu", ctrl_env={
+                              "CONFIG_whisk_ha_activeActive": "true",
+                              "CONFIG_whisk_ha_activeActive_partitions":
+                                  "8",
+                              "CONFIG_whisk_limits_invocationsPerMinute":
+                                  "100000",
+                              "CONFIG_whisk_limits_concurrentInvocations":
+                                  "1000"})
+        cluster.ctrl_extra_argv = [
+            "--balancer-snapshot", snap,
+            "--balancer-snapshot-interval", "1",
+            "--balancer-journal", jdir, "--ha"]
+        cluster.start()
+        try:
+            async def drive():
+                timeout = aiohttp.ClientTimeout(total=30)
+                async with aiohttp.ClientSession(timeout=timeout) as s:
+                    for port in cluster.ctrl_ports:
+                        assert await cluster.wait_healthy(s, port=port,
+                                                          timeout=240)
+                    base = cluster.api()  # through the edge
+
+                    async def ready(port):
+                        try:
+                            async with s.get(
+                                    f"http://127.0.0.1:{port}/admin/ready",
+                                    headers=HDRS) as r:
+                                return r.status, await r.json(
+                                    content_type=None)
+                        except (aiohttp.ClientError,
+                                asyncio.TimeoutError):
+                            return 0, {}
+
+                    # every controller owns a ring slice (200 = owns >=1)
+                    for _ in range(240):
+                        rs = [await ready(p) for p in cluster.ctrl_ports]
+                        if all(st == 200 for st, _ in rs) and sum(
+                                d.get("owned_partitions", 0)
+                                for _, d in rs) == 8:
+                            break
+                        await asyncio.sleep(0.5)
+                    else:
+                        raise AssertionError(
+                            f"ownership never converged: {rs}")
+                    dead_owned = {
+                        p["partition"]
+                        for p in rs[0][1]["partitions"]
+                        if p["role"] == "active"}
+                    assert dead_owned, "controller0 must own something"
+
+                    async with s.put(f"{base}/namespaces/_/actions/aaj",
+                                     headers=HDRS,
+                                     json={"exec": {"kind": "python:3",
+                                                    "code": side_code}}
+                                     ) as r:
+                        assert r.status == 200, await r.text()
+
+                    async def invoke(n):
+                        # NO client retries: a retry would legitimately
+                        # re-execute and read as a false double execution
+                        try:
+                            async with s.post(
+                                    f"{base}/namespaces/_/actions/aaj"
+                                    "?blocking=true&result=true",
+                                    headers=HDRS,
+                                    json={"n": n,
+                                          "dir": str(effects)}) as r:
+                                body = await r.json(content_type=None)
+                                return (r.status == 200
+                                        and body.get("n") == n)
+                        except (aiohttp.ClientError, asyncio.TimeoutError,
+                                ValueError):
+                            return False
+
+                    for n in range(120):
+                        if await invoke(10000 + n):
+                            break
+                        await asyncio.sleep(0.5)
+                    else:
+                        raise AssertionError("no active emerged")
+
+                    from tools.loadgen import make_schedule, open_loop
+                    success_t: list = []
+
+                    async def one(i, sched_ns):
+                        ok = await invoke(i)
+                        if ok:
+                            success_t.append(time.monotonic())
+                        return ok
+
+                    rate, duration = 4.0, 45.0
+                    offsets = make_schedule(rate, int(rate * duration),
+                                            dist="constant")
+                    kill_at = duration / 3.0
+
+                    async def killer():
+                        await asyncio.sleep(kill_at)
+                        cluster.kill("controller0")  # SIGKILL an active
+                        return time.monotonic()
+
+                    kill_task = asyncio.ensure_future(killer())
+                    row = await open_loop(one, offsets, drain_timeout=60.0)
+                    t_kill = await kill_task
+
+                    post = [t for t in success_t if t > t_kill]
+                    assert post, (
+                        f"no successes after the kill (completed "
+                        f"{row['completed']}/{row['offered']})")
+                    assert await invoke(99999), \
+                        "survivors must serve after the burst"
+                    # the dead controller's partitions were absorbed by
+                    # the two survivors, at bumped epochs
+                    for _ in range(120):
+                        rs = [await ready(p)
+                              for p in cluster.ctrl_ports[1:]]
+                        owned = set()
+                        for _st, d in rs:
+                            owned |= {p["partition"]
+                                      for p in d.get("partitions", [])
+                                      if p["role"] == "active"}
+                        if owned == set(range(8)):
+                            break
+                        await asyncio.sleep(0.5)
+                    assert owned == set(range(8)), \
+                        f"survivors absorbed only {sorted(owned)} " \
+                        f"(dead owned {sorted(dead_owned)})"
+                    stamps = sorted(success_t)
+                    gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+                    max_gap = max(gaps) if gaps else 0.0
+                    assert max_gap < 45.0, \
+                        f"absorb downtime {max_gap:.1f}s exceeds bound"
+                    return row
+
+            row = asyncio.run(drive())
+
+            # ZERO double execution: every n's side effect at most once
+            seen = {}
+            for name in os.listdir(effects):
+                n = name.split("-", 1)[0]
+                seen[n] = seen.get(n, 0) + 1
+            doubles = {n: c for n, c in seen.items() if c > 1}
+            assert not doubles, f"double-executed activations: {doubles}"
+            assert seen, "the burst must have executed something"
+
+            # zero lost/duplicated journal seqs, per instance journal
+            from openwhisk_tpu.controller.loadbalancer.journal import \
+                PlacementJournal
+            checked = 0
+            for i in range(3):
+                d = os.path.join(jdir, f"ctrl{i}")
+                if not os.path.isdir(d):
+                    continue
+                seqs = [int(r["seq"])
+                        for r in PlacementJournal(d).records(0)]
+                if not seqs:
+                    continue
+                checked += 1
+                assert len(seqs) == len(set(seqs)), \
+                    f"ctrl{i}: duplicated journal seqs"
+                assert seqs == sorted(seqs), \
+                    f"ctrl{i}: journal seqs out of order"
+            assert checked >= 1, "at least one journal must have records"
+        finally:
+            cluster.stop()
+
+
+@pytest.mark.slow
 class TestBalancerSnapshotResume:
     def test_hard_killed_controller_resumes_from_snapshot(self, tmp_path):
         """SURVEY §5.4 end-to-end: a TPU controller running with

@@ -1,0 +1,874 @@
+"""Active/active partitioned controllers (ISSUE 15), tier-1 half.
+
+Covers the partition ring (determinism, rendezvous stability), the
+membership generalization (per-partition claims over the heartbeats,
+failover + planned-rebalance handoff, per-partition zombie demotion),
+the balancer's per-partition refusal/fence stamping, the invoker's
+per-partition discard, cross-partition spillover, the edge ring routing
++ bounded retry plumbing, /admin/ready, and the off-switch/N=1 parity
+acceptance. The SIGKILL-mid-burst chaos proof lives in
+tests/test_ha_chaos.py (slow) and the bench `partition_chaos` rider.
+"""
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from openwhisk_tpu.controller.loadbalancer import (LoadBalancerException,
+                                                   TpuBalancer)
+from openwhisk_tpu.controller.loadbalancer.journal import PlacementJournal
+from openwhisk_tpu.controller.loadbalancer.membership import \
+    ControllerMembership
+from openwhisk_tpu.controller.loadbalancer.partitions import (
+    ActiveActiveConfig, PartitionRing, active_active_config,
+    ring_from_config)
+from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+from openwhisk_tpu.messaging import MemoryMessagingProvider
+
+from tests.test_balancers import _fleet, _ping_all, make_action, make_msg
+
+
+def _balancer(provider, instance="0", **kw):
+    return TpuBalancer(provider, ControllerInstanceId(instance),
+                       managed_fraction=1.0, blackbox_fraction=0.0, **kw)
+
+
+def _ns_for_partition(ring, pid, tag="ns"):
+    """A namespace name hashing to `pid` (deterministic hash: scan)."""
+    i = 0
+    while True:
+        ns = f"{tag}{i}"
+        if ring.partition_of(ns) == pid:
+            return ns
+        i += 1
+
+
+async def until(cond, timeout=8.0, step=0.02):
+    for _ in range(int(timeout / step)):
+        if cond():
+            return True
+        await asyncio.sleep(step)
+    return cond()
+
+
+class TestPartitionRing:
+    def test_pow2_rounding_and_determinism(self):
+        assert PartitionRing(10).n_partitions == 16
+        r1, r2 = PartitionRing(16), PartitionRing(16)
+        for ns in ("guest", "alice", "bob", "hot-ns"):
+            assert r1.partition_of(ns) == r2.partition_of(ns)
+            assert 0 <= r1.partition_of(ns) < 16
+
+    def test_ownership_covers_all_partitions_disjointly(self):
+        ring = PartitionRing(32)
+        own = ring.ownership([0, 1, 2])
+        assert sorted(own) == list(range(32))
+        assert set(own.values()) <= {0, 1, 2}
+        # each member's partition list matches the map
+        for m in (0, 1, 2):
+            assert ring.partitions_of(m, [0, 1, 2]) == \
+                [p for p, o in own.items() if o == m]
+
+    def test_rendezvous_stability_on_member_death(self):
+        """Removing a member must move ONLY that member's partitions —
+        the property that makes a rebalance a bounded failover."""
+        ring = PartitionRing(64)
+        before = ring.ownership([0, 1, 2])
+        after = ring.ownership([0, 2])
+        for pid, owner in before.items():
+            if owner != 1:
+                assert after[pid] == owner, \
+                    f"partition {pid} moved without cause"
+            else:
+                assert after[pid] in (0, 2)
+
+    def test_rank_walks_owner_first(self):
+        ring = PartitionRing(16)
+        for pid in range(16):
+            ranked = ring.rank(pid, [0, 1, 2])
+            assert sorted(ranked) == [0, 1, 2]
+            assert ranked[0] == ring.owner_of(pid, [0, 1, 2])
+
+    def test_config_off_switch_and_scalar_form(self, monkeypatch):
+        monkeypatch.delenv("CONFIG_whisk_ha_activeActive", raising=False)
+        assert ring_from_config() is None  # default off
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive", "true")
+        ring = ring_from_config()
+        assert ring is not None and ring.n_partitions == 16
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive", "false")
+        assert ring_from_config() is None
+
+    def test_config_nested_form(self, monkeypatch):
+        monkeypatch.delenv("CONFIG_whisk_ha_activeActive", raising=False)
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive_enabled", "true")
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive_partitions", "8")
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive_spillover", "true")
+        cfg = active_active_config()
+        assert cfg.enabled and cfg.partitions == 8 and cfg.spillover
+        assert ring_from_config(cfg).n_partitions == 8
+
+    def test_config_scalar_and_knobs_together(self, monkeypatch):
+        # the documented deployment form: scalar enable + nested knobs
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive", "true")
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive_partitions", "8")
+        monkeypatch.setenv("CONFIG_whisk_ha_activeActive_spilloverDepth",
+                           "64")
+        cfg = active_active_config()
+        assert cfg.enabled and cfg.partitions == 8
+        assert cfg.spillover_depth == 64
+
+
+class _BalancerStub:
+    cluster_size = 3
+    metrics = None
+
+    def update_cluster(self, n):
+        self.cluster_size = n
+
+
+def _membership(provider, i, ring, events, heartbeat=0.05, timeout=1.0):
+    # timeout is deliberately generous vs the 0.05s heartbeat: these tests
+    # assert EXACT ownership maps, and a pegged CI box can starve an event
+    # loop past a tight member timeout — a correct-but-unwanted failover
+    # that breaks the planned-rebalance invariants being tested
+    def cb(gained, lost):
+        events[i].append(("gain", gained) if gained else ("lose", lost))
+
+    m = ControllerMembership(provider, ControllerInstanceId(str(i)),
+                             _BalancerStub(), heartbeat_s=heartbeat,
+                             member_timeout_s=timeout, ring=ring,
+                             on_partitions=cb,
+                             load_hint=lambda: float(i))
+    m.start()
+    return m
+
+
+class TestMembershipPartitions:
+    def test_three_actives_converge_to_disjoint_full_ownership(self):
+        ring = PartitionRing(16)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            events = {0: [], 1: [], 2: []}
+            ms = [_membership(provider, i, ring, events) for i in range(3)]
+            ok = await until(lambda: sum(
+                len(m.owned_partitions) for m in ms) == 16 and all(
+                m.owned_partitions for m in ms) or False, timeout=10.0)
+            owned = [m.owned_partitions for m in ms]
+            expected = ring.ownership([0, 1, 2])
+            loads = dict(ms[0].peer_loads)
+            for m in ms:
+                await m.stop()
+            return ok, owned, expected, loads
+
+        ok, owned, expected, loads = asyncio.run(go())
+        assert ok, owned
+        # disjoint and exactly the rendezvous map
+        assert not (owned[0] & owned[1] or owned[0] & owned[2]
+                    or owned[1] & owned[2])
+        for i in range(3):
+            assert owned[i] == {p for p, o in expected.items() if o == i}
+        # heartbeats carried the spillover load hints
+        assert loads.get(1) == 1.0 and loads.get(2) == 2.0
+
+    def test_member_death_moves_its_partitions_with_epoch_bump(self):
+        ring = PartitionRing(16)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            events = {0: [], 1: [], 2: []}
+            ms = [_membership(provider, i, ring, events) for i in range(3)]
+            assert await until(lambda: sum(
+                len(m.owned_partitions) for m in ms) == 16, timeout=10.0)
+            dead_parts = set(ms[0].owned_partitions)
+            # hard death: no leave, just silence
+            await ms[0]._ticker.stop()
+            await ms[0]._feed.stop()
+            ok = await until(lambda: (ms[1].owned_partitions
+                                      | ms[2].owned_partitions)
+                             >= dead_parts, timeout=12.0)
+            # every absorbed partition claimed at a HIGHER epoch, with
+            # the dead instance named as the previous owner
+            gains = [g for i in (1, 2) for kind, g in events[i]
+                     if kind == "gain"]
+            absorbed = {pid: (epoch, prev)
+                        for g in gains for pid, epoch, prev in g}
+            for m in ms[1:]:
+                await m.stop()
+            return ok, dead_parts, absorbed
+
+        ok, dead_parts, absorbed = asyncio.run(go())
+        assert ok, "survivors never absorbed the dead member's partitions"
+        for pid in dead_parts:
+            epoch, prev = absorbed[pid]
+            assert epoch >= 2, f"partition {pid} claimed without a bump"
+            assert prev == 0, \
+                f"partition {pid} gained without naming the dead owner"
+
+    def test_join_rebalances_only_the_joiners_partitions(self):
+        """Planned ring rebalance: a new controller joining steals only
+        the partitions the ring assigns it (higher-epoch claims), and
+        the old owners demote exactly those."""
+        ring = PartitionRing(16)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            events = {0: [], 1: [], 2: []}
+            ms = {i: _membership(provider, i, ring, events)
+                  for i in (0, 1)}
+            assert await until(lambda: sum(
+                len(m.owned_partitions) for m in ms.values()) == 16,
+                timeout=10.0)
+            before = {i: set(ms[i].owned_partitions) for i in (0, 1)}
+            ms[2] = _membership(provider, 2, ring, events)
+            want2 = set(ring.partitions_of(2, [0, 1, 2]))
+            ok = await until(lambda: ms[2].owned_partitions == want2,
+                             timeout=12.0)
+            after = {i: set(ms[i].owned_partitions) for i in (0, 1, 2)}
+            for m in ms.values():
+                await m.stop()
+            return ok, before, after, want2
+
+        ok, before, after, want2 = asyncio.run(go())
+        assert ok, "joiner never took its rendezvous partitions"
+        assert after[2] == want2
+        for i in (0, 1):
+            # the old owners kept everything the ring still gives them
+            assert after[i] == before[i] - want2
+
+    def test_zombie_demotes_per_partition_keeping_the_rest(self):
+        """Satellite: a stale-epoch old owner is demoted for EXACTLY the
+        partitions a peer superseded while keeping the ones it still
+        owns (the per-partition generalization of PR 8's zombie test)."""
+        ring = PartitionRing(16)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            events = {0: []}
+            m = _membership(provider, 0, ring, events)
+            assert await until(
+                lambda: len(m.owned_partitions) == 16, timeout=10.0)
+            victim = sorted(m.owned_partitions)[:4]
+            for pid in victim:
+                # a peer's forged higher-epoch claim supersedes this
+                # partition only
+                m._observe_part_claim(pid, m._pepoch[pid] + 3, 9)
+            owned_after = set(m.owned_partitions)
+            lost_events = [lost for kind, lost in events[0]
+                           if kind == "lose"]
+            await m.stop()
+            return victim, owned_after, lost_events
+
+        victim, owned_after, lost_events = asyncio.run(go())
+        assert owned_after == set(range(16)) - set(victim)
+        lost_pids = {pid for lost in lost_events for pid, _e in lost}
+        assert lost_pids == set(victim)
+
+
+class TestPartitionFencingBalancer:
+    def test_refuses_unowned_partition_and_stamps_owned(self):
+        ring = PartitionRing(8)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.set_partition_mode(ring)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            action = make_action("pf", memory=128)
+            ns_owned = _ns_for_partition(ring, 3, "own")
+            ns_other = _ns_for_partition(ring, 5, "oth")
+            bal.set_partition_leadership(3, 7, True)
+            with pytest.raises(LoadBalancerException):
+                await bal.publish(action, make_msg(
+                    action, Identity.generate(ns_other), True))
+            p = await bal.publish(action, make_msg(
+                action, Identity.generate(ns_owned), True))
+            await asyncio.wait_for(p, 10)
+            await asyncio.sleep(0.1)
+            stamps = [(m.fence_part, m.fence_epoch)
+                      for inv in invokers for m in inv.handled]
+            ready = bal.partitions_json()
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return stamps, ready
+
+        stamps, ready = asyncio.run(go())
+        assert stamps and all(s == (3, 7) for s in stamps)
+        assert ready[3] == {"partition": 3, "epoch": 7, "role": "active",
+                            "replay": "ready"}
+        assert ready[5]["role"] == "standby"
+
+    def test_spillover_credential_admits_fenced_row(self):
+        """A row fence-stamped at the partition's current epoch passes
+        the refusal even on a non-owner (the spillover admission), while
+        a stale-epoch stamp is refused like any zombie work."""
+        ring = PartitionRing(8)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.set_partition_mode(ring)
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            action = make_action("sc", memory=128)
+            ns = _ns_for_partition(ring, 2, "sp")
+            ident = Identity.generate(ns)
+            # peer knowledge: epoch 5 claimed elsewhere
+            bal.partition_epochs[2] = 5
+            fresh = make_msg(action, ident, True)
+            fresh.fence_part, fresh.fence_epoch = 2, 5
+            stale = make_msg(action, ident, True)
+            stale.fence_part, stale.fence_epoch = 2, 4
+            with pytest.raises(LoadBalancerException):
+                await bal.publish(action, stale)
+            p = await bal.publish(action, fresh)
+            await asyncio.wait_for(p, 10)
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return True
+
+        assert asyncio.run(go())
+
+
+class TestInvokerPartitionFence:
+    def test_invoker_discards_stale_epoch_per_partition(self):
+        from openwhisk_tpu.containerpool import ContainerPoolConfig
+        from openwhisk_tpu.core.entity import (ActivationId, ExecManifest,
+                                               InvokerInstanceId, MB)
+        from openwhisk_tpu.database import (ArtifactActivationStore,
+                                            EntityStore, MemoryArtifactStore)
+        from openwhisk_tpu.invoker.reactive import InvokerReactive
+        from openwhisk_tpu.messaging import ActivationMessage
+        from openwhisk_tpu.utils.transaction import TransactionId
+
+        async def go():
+            ExecManifest.initialize()
+            provider = MemoryMessagingProvider()
+            store = MemoryArtifactStore()
+
+            class FactoryStub:
+                async def cleanup(self):
+                    pass
+
+            inv = InvokerReactive(
+                InvokerInstanceId(0, user_memory=MB(1024)), provider,
+                EntityStore(store), ArtifactActivationStore(store),
+                FactoryStub(),
+                pool_config=ContainerPoolConfig(user_memory=MB(1024)))
+            released = []
+
+            class FeedStub:
+                def processed(self):
+                    released.append(1)
+
+            ident = Identity.generate("guest")
+            action = make_action("pfence", memory=128)
+
+            def payload(part, epoch):
+                return ActivationMessage(
+                    TransactionId(), action.fully_qualified_name, None,
+                    ident, ActivationId.generate(),
+                    ControllerInstanceId("0"), False, {},
+                    fence_epoch=epoch, fence_part=part).serialize()
+
+            # partition 1 adopts epoch 4; partition 2 adopts epoch 1
+            await inv._process(payload(1, 4), FeedStub())
+            await inv._process(payload(2, 1), FeedStub())
+            assert inv.fenced_discards == 0
+            # partition 1's zombie (epoch 2) is discarded...
+            before = len(released)
+            await inv._process(payload(1, 2), FeedStub())
+            assert inv.fenced_discards == 1
+            assert len(released) == before + 1, \
+                "a discarded message must still release feed capacity"
+            # ...while partition 2's epoch-1 traffic still runs, and the
+            # legacy global fence is untouched by partition traffic
+            await inv._process(payload(2, 1), FeedStub())
+            assert inv.fenced_discards == 1
+            assert inv._max_fence_epoch == -1
+            return inv._fence_epochs
+
+        fences = asyncio.run(go())
+        assert fences == {1: 4, 2: 1}
+
+
+class TestPartitionJournalAbsorb:
+    def _drive(self, bal, ring, namespaces, per_ns=3):
+        """Serial publishes for each namespace (await each → quiesced,
+        deterministic batches)."""
+
+        async def go(invokers):
+            action = make_action("pj", memory=128)
+            for ns in namespaces:
+                ident = Identity.generate(ns)
+                for _ in range(per_ns):
+                    p = await bal.publish(action, make_msg(action, ident,
+                                                           True))
+                    await asyncio.wait_for(p, 10)
+
+        return go
+
+    def test_records_carry_parts_and_absorb_filters_to_them(self,
+                                                            tmp_path):
+        ring = PartitionRing(8)
+        jdir = str(tmp_path / "wal0")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.set_partition_mode(ring)
+            bal.attach_journal(PlacementJournal(jdir))
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4)
+            await _ping_all(invokers, producer)
+            ns_a = _ns_for_partition(ring, 1, "a")
+            ns_b = _ns_for_partition(ring, 6, "b")
+            bal.set_partition_leadership(1, 2, True)
+            bal.set_partition_leadership(6, 3, True)
+            await self._drive(bal, ring, [ns_a, ns_b])(invokers)
+            for _ in range(50):
+                if not (bal._pending or bal._inflight_steps):
+                    break
+                await asyncio.sleep(0.05)
+            assert bal.journal.flush()
+
+            reader = PlacementJournal(jdir)
+            recs = list(reader.records(0))
+            batches = [r for r in recs if r.get("t") == "batch"]
+            # the survivor absorbs ONLY partition 1
+            surv = _balancer(provider, "1")
+            surv.set_partition_mode(ring)
+            await surv.start()
+            await _ping_all(invokers, producer)
+            surv.set_partition_leadership(1, 3, True)
+            stats = surv.absorb_partitions([1], PlacementJournal(jdir))
+            own_seq = surv._journal_seq
+            await bal.close()
+            await surv.close()
+            for inv in invokers:
+                await inv.stop()
+            return recs, batches, stats, own_seq
+
+        recs, batches, stats, own_seq = asyncio.run(go())
+        assert batches, "the run must journal batch records"
+        for b in batches:
+            assert b["parts"] and set(b["parts"]) <= {1, 6}
+            assert set(b["pe"]) == {str(p) for p in b["parts"]}
+        only_a = [b for b in batches if b["parts"] == [1]]
+        only_b = [b for b in batches if b["parts"] == [6]]
+        assert only_a and only_b, "serial publishes batch per namespace"
+        # the absorb replayed partition 1's records (plus their acks) and
+        # filtered partition 6's out, without touching the absorber's own
+        # journal numbering
+        assert stats["replayed"] >= len(only_a)
+        assert stats["filtered_out"] >= len(only_b)
+        assert stats["absorbed_partitions"] == [1]
+        assert own_seq == 0, "foreign seqs must not move the own cursor"
+
+    def test_replay_drops_stale_epochs_per_partition(self, tmp_path):
+        """Satellite: a zombie owner's late records for a superseded
+        partition drop at replay while the SAME journal's records for a
+        still-owned partition replay — per-partition staleness."""
+        ring = PartitionRing(8)
+        jdir = str(tmp_path / "walz")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.set_partition_mode(ring)
+            bal.attach_journal(PlacementJournal(jdir))
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4)
+            await _ping_all(invokers, producer)
+            ns_a = _ns_for_partition(ring, 1, "a")
+            ns_b = _ns_for_partition(ring, 6, "b")
+            bal.set_partition_leadership(1, 2, True)
+            bal.set_partition_leadership(6, 2, True)
+            # zombie half: partition 1 records at epoch 2
+            await self._drive(bal, ring, [ns_a])(invokers)
+            # partition 1 is superseded (epoch 3 elsewhere); partition 6
+            # stays ours — later records stamp the NEW epoch for 1 only
+            # if it were still placed here, but ownership was lost:
+            bal.set_partition_leadership(1, 3, False)
+            await self._drive(bal, ring, [ns_b])(invokers)
+            for _ in range(50):
+                if not (bal._pending or bal._inflight_steps):
+                    break
+                await asyncio.sleep(0.05)
+            assert bal.journal.flush()
+            # forge the supersession evidence INTO the journal stream, as
+            # the new owner's first record for partition 1 would carry it
+            bal._journal_append({"t": "batch", "R": 1, "H": 1, "B": 8,
+                                 "rows": 0, "b": 0, "buf": "",
+                                 "aids": [], "parts": [1],
+                                 "pe": {"1": 3}})
+            assert bal.journal.flush()
+
+            reader = PlacementJournal(jdir)
+            recs = list(reader.records(0))
+            # replay with the supersession bound present: partition 1's
+            # epoch-2 batches are stale ONLY if they follow the epoch-3
+            # first-seq — here the forged record is LAST, so everything
+            # before it stays fresh; now reorder: treat the forged
+            # record's seq as 0 by replaying a reversed-bounds stream
+            surv = _balancer(provider, "1")
+            surv.set_partition_mode(ring)
+            await surv.start()
+            await _ping_all(invokers, producer)
+            # move the forged supersession to the FRONT (first_seq for
+            # (1, epoch 3) = smallest): zombie epoch-2 partition-1
+            # records now all drop; partition 6 records all survive
+            forged = dict(recs[-1], seq=0)
+            stats = surv.absorb_partitions(
+                [1, 6], _FakeJournal([forged] + recs[:-1]))
+            await bal.close()
+            await surv.close()
+            for inv in invokers:
+                await inv.stop()
+            return recs, stats
+
+        recs, stats = asyncio.run(go())
+        a_batches = [r for r in recs
+                     if r.get("t") == "batch" and r.get("parts") == [1]
+                     and r.get("pe", {}).get("1") == 2]
+        b_batches = [r for r in recs
+                     if r.get("t") == "batch" and r.get("parts") == [6]]
+        assert a_batches and b_batches
+        assert stats["stale_epoch_dropped"] >= len(a_batches)
+        assert stats["replayed"] >= len(b_batches)
+
+
+class _FakeJournal:
+    def __init__(self, recs):
+        self._recs = recs
+
+    def records(self, after_seq=0):
+        return iter([r for r in self._recs
+                     if int(r.get("seq", 0)) > after_seq or "seq" not in r
+                     or int(r.get("seq", 0)) == 0])
+
+
+class TestOffSwitchParity:
+    def test_off_journal_wire_format_unchanged(self, tmp_path):
+        """CONFIG off (no ring): journal records carry NO partition keys
+        — byte-compatible with the PR 8 format."""
+        jdir = str(tmp_path / "waloff")
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.attach_journal(PlacementJournal(jdir))
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            action = make_action("off", memory=128)
+            ident = Identity.generate("guest")
+            p = await bal.publish(action, make_msg(action, ident, True))
+            await asyncio.wait_for(p, 10)
+            await asyncio.sleep(0.2)
+            assert bal.journal.flush()
+            recs = list(PlacementJournal(jdir).records(0))
+            fences = [m.fence_epoch for inv in invokers
+                      for m in inv.handled]
+            parts = [m.fence_part for inv in invokers
+                     for m in inv.handled]
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return recs, fences, parts
+
+        recs, fences, parts = asyncio.run(go())
+        assert recs
+        for r in recs:
+            assert "parts" not in r and "pe" not in r
+        assert all(f is None for f in fences)
+        assert all(p is None for p in parts)
+
+    def test_n1_on_placement_parity_with_off(self):
+        """N=1 with the ring on (one controller owning every partition)
+        places bit-identically to the ring-off path, and its journal
+        records differ ONLY by the additive parts/pe keys."""
+
+        async def run_one(ring_on, jdir=None):
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            ring = PartitionRing(8)
+            if ring_on:
+                bal.set_partition_mode(ring)
+                for pid in range(8):
+                    bal.set_partition_leadership(pid, 1, True)
+            if jdir is not None:
+                bal.attach_journal(PlacementJournal(jdir))
+            await bal.start()
+            invokers, producer = await _fleet(provider, 4)
+            await _ping_all(invokers, producer)
+            actions = [make_action(f"par{i}", memory=128) for i in range(3)]
+            idents = [Identity.generate(f"pns{i}") for i in range(4)]
+            placed = []
+            for i in range(12):
+                a = actions[i % 3]
+                p = await bal.publish(a, make_msg(a, idents[i % 4], True))
+                await asyncio.wait_for(p, 10)
+            for inv in invokers:
+                for m in inv.handled:
+                    placed.append((m.action.name.name,
+                                   inv.instance.instance))
+            books = np.asarray(bal.state.free_mb).copy()
+            await bal.close()
+            for inv in invokers:
+                await inv.stop()
+            return placed, books
+
+        async def go(tmpdir=None):
+            on = await run_one(True)
+            off = await run_one(False)
+            return on, off
+
+        (placed_on, books_on), (placed_off, books_off) = asyncio.run(go())
+        assert sorted(placed_on) == sorted(placed_off), \
+            "N=1 active/active must place exactly like the off path"
+        assert np.array_equal(books_on, books_off), \
+            "N=1 active/active books must equal the off path's"
+
+
+class TestSpillover:
+    def test_overflow_batch_forwards_to_peer_and_executes(self):
+        from openwhisk_tpu.controller.loadbalancer.spillover import (
+            SpilloverReceiver, SpilloverSender)
+
+        ring = PartitionRing(8)
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            b0 = _balancer(provider, "0")
+            b1 = _balancer(provider, "1")
+            for b in (b0, b1):
+                b.set_partition_mode(ring)
+                await b.start()
+            invokers, producer = await _fleet(provider, 2)
+            await _ping_all(invokers, producer)
+            action = make_action("hot", memory=128)
+            ns = _ns_for_partition(ring, 4, "hot")
+            ident = Identity.generate(ns)
+            b0.set_partition_leadership(4, 2, True)
+            b1.partition_epochs[4] = 2  # peer folded the claim
+
+            class MembershipStub:
+                @staticmethod
+                def least_loaded_peer():
+                    return 1
+
+            class StoreStub:
+                @staticmethod
+                async def get_action(name, rev=None):
+                    class Doc:
+                        @staticmethod
+                        def to_executable():
+                            return action
+                    return Doc()
+
+            b0.spillover_sink = SpilloverSender(provider, MembershipStub())
+            b0.spillover_depth = 2
+            receiver = SpilloverReceiver(
+                provider, ControllerInstanceId("1"), b1, StoreStub())
+            receiver.start()
+            # 6 non-blocking rows through the batched publish: depth 2
+            # → 4 rows divert to the peer
+            pairs = [(action, make_msg(action, ident, False))
+                     for _ in range(6)]
+            outs = b0.publish_many(pairs)
+            await asyncio.gather(*outs)
+            # every row executes exactly once, across the two books
+            for _ in range(100):
+                if sum(len(inv.handled) for inv in invokers) >= 6:
+                    break
+                await asyncio.sleep(0.05)
+            handled = [m for inv in invokers for m in inv.handled]
+            spilled = [m for m in handled
+                       if m.root_controller_index.name == "1"]
+            local = [m for m in handled
+                     if m.root_controller_index.name == "0"]
+            stamps = {(m.fence_part, m.fence_epoch) for m in handled}
+            counts = (b0.spilled_rows, receiver.received, receiver.refused)
+            await receiver.stop()
+            await b0.close()
+            await b1.close()
+            for inv in invokers:
+                await inv.stop()
+            return handled, spilled, local, stamps, counts
+
+        handled, spilled, local, stamps, counts = asyncio.run(go())
+        assert len(handled) == 6, "every row must execute exactly once"
+        assert len(spilled) == 4 and len(local) == 2
+        assert stamps == {(4, 2)}, "every hop is fenced at the epoch"
+        assert counts == (4, 4, 0)
+
+
+class TestEdgeRingRouting:
+    def _proxy(self, n=3, ring=None, **kw):
+        from openwhisk_tpu.edge.proxy import EdgeProxy
+        return EdgeProxy.for_controllers(
+            [f"http://127.0.0.1:{3000 + i}" for i in range(n)],
+            ring=ring, **kw)
+
+    def test_owner_first_order_and_fallback(self):
+        ring = PartitionRing(16)
+        proxy = self._proxy(ring=ring)
+        ns = "alice"
+        pid = ring.partition_of(ns)
+        ranked = ring.rank(pid, [0, 1, 2])
+        order = proxy._pick_order(ns)
+        assert [u.url for u in order] == \
+            [f"http://127.0.0.1:{3000 + i}" for i in ranked]
+        # no namespace (or `_`): round-robin, all upstreams present
+        assert len(proxy._pick_order(None)) == 3
+
+    def test_path_namespace_extraction(self):
+        proxy = self._proxy()
+        f = proxy._path_namespace
+        assert f("/api/v1/namespaces/alice/actions/x") == "alice"
+        assert f("/api/v1/namespaces/_/actions/x") is None
+        assert f("/metrics") is None
+        assert f("/api/v1/namespaces/") is None
+
+    def test_backoff_is_jittered_and_bounded(self):
+        proxy = self._proxy(retry_backoff_ms=20, retry_backoff_max_ms=100)
+        for attempt in (1, 2, 3, 8):
+            for _ in range(16):
+                d = proxy._backoff_s(attempt)
+                assert 0.0 <= d <= 0.1
+        assert proxy.retry_attempts == 0  # auto: two passes, min 4
+
+    def test_retry_counter_shape(self):
+        proxy = self._proxy()
+        proxy._count_retry("http_503")
+        proxy._count_retry("http_503")
+        proxy._count_retry("connect")
+        assert proxy.retry_total == {"http_503": 2, "connect": 1}
+
+
+class TestAdminReady:
+    def _ready(self, lb, membership=None):
+        from openwhisk_tpu.controller.api import ControllerApi
+
+        class ControllerStub:
+            load_balancer = lb
+
+        ControllerStub.membership = membership
+        api = ControllerApi(ControllerStub())
+        return asyncio.run(api.admin_ready(None))
+
+    def test_single_mode_is_ready(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            resp = None
+            try:
+                from openwhisk_tpu.controller.api import ControllerApi
+
+                class C:
+                    load_balancer = bal
+                    membership = None
+
+                resp = await ControllerApi(C()).admin_ready(None)
+            finally:
+                await bal.close()
+            return resp
+
+        resp = asyncio.run(go())
+        assert resp.status == 200
+        doc = json.loads(resp.body)
+        assert doc["mode"] == "single" and doc["ready"]
+
+    def test_active_active_roles_and_standby_for_all_503(self):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.set_partition_mode(PartitionRing(8))
+            from openwhisk_tpu.controller.api import ControllerApi
+
+            class C:
+                load_balancer = bal
+                membership = None
+
+            api = ControllerApi(C())
+            standby = await api.admin_ready(None)
+            bal.set_partition_leadership(2, 5, True)
+            active = await api.admin_ready(None)
+            await bal.close()
+            return standby, active
+
+        standby, active = asyncio.run(go())
+        assert standby.status == 503, "standby-for-all must answer 503"
+        doc = json.loads(active.body)
+        assert active.status == 200
+        assert doc["mode"] == "active_active" and doc["owned_partitions"] == 1
+        assert doc["partitions"][2]["role"] == "active"
+        assert doc["journal"] == {"attached": False,
+                                  "stall_firing": False}
+
+    def test_standby_and_journal_stall_surface(self, tmp_path):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.set_leadership(4, False)
+            bal.attach_journal(PlacementJournal(str(tmp_path / "w")))
+            from openwhisk_tpu.controller.api import ControllerApi
+
+            class C:
+                load_balancer = bal
+                membership = None
+
+            api = ControllerApi(C())
+            resp = await api.admin_ready(None)
+            await bal.close()
+            return resp
+
+        resp = asyncio.run(go())
+        assert resp.status == 503
+        doc = json.loads(resp.body)
+        assert doc == {"mode": "active_standby", "role": "standby",
+                       "epoch": 4, "ready": False,
+                       "journal": {"attached": True, "lag_batches": 0,
+                                   "stall_firing": False}}
+
+
+class TestJournalStallAlert:
+    def test_rule_exists_and_fires_on_sustained_lag(self):
+        from openwhisk_tpu.controller.loadbalancer.anomaly import (
+            AlertEngine, build_rules)
+
+        rules = build_rules(None)
+        assert "journal_stall" in rules
+        rule = rules["journal_stall"]
+        assert rule.scope == "global" and rule.severity == "critical"
+        engine = AlertEngine({"journal_stall": rule})
+        # lag above threshold, sustained past for_s -> firing
+        engine.evaluate(0.0, {"journal_stall": [((), 100.0)]})
+        assert not engine.firing_counts()
+        engine.evaluate(rule.for_s + 1.0, {"journal_stall": [((), 120.0)]})
+        assert ("journal_stall", "critical") in engine.firing_counts()
+        # lag recovers -> resolves
+        engine.evaluate(rule.for_s + 2.0, {"journal_stall": [((), 0.0)]})
+        assert not engine.firing_counts()
+
+    def test_attach_journal_registers_the_signal(self, tmp_path):
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = _balancer(provider)
+            bal.attach_journal(PlacementJournal(str(tmp_path / "w")))
+            sig = bal.anomaly.extra_signals["journal_lag_batches"]
+            v0 = sig()
+            bal.journal = None  # detach: the subject vanishes
+            v1 = sig()
+            await bal.close()
+            return v0, v1
+
+        v0, v1 = asyncio.run(go())
+        assert v0 == 0.0 and v1 is None

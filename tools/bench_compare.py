@@ -33,7 +33,9 @@ from typing import Optional, Tuple
 
 #: (label, path into the round dict, direction). "higher" metrics regress
 #: when the new value drops below old*(1-thr); "lower" metrics (latencies,
-#: downtime) regress when the new value climbs above old*(1+thr).
+#: downtime) regress when the new value climbs above old*(1+thr); "zero"
+#: metrics are correctness invariants — ANY nonzero new value regresses,
+#: no threshold (a 0->1 jump has no percentage).
 HEADLINES = (
     ("placements_per_sec", ("value",), "higher"),
     ("balancer_activations_per_sec",
@@ -57,6 +59,15 @@ HEADLINES = (
     ("bus_coalesced_msgs_per_sec",
      ("bus_coalesce_speedup", "coalesced_msgs_per_sec"), "higher"),
     ("failover_downtime_ms", ("failover_downtime", "downtime_ms"), "lower"),
+    # ISSUE 15: active/active partitioned control under a mid-burst kill.
+    # double_executions is the zero-double-execution CONTRACT, not a
+    # perf number — any nonzero value fails the round outright.
+    ("partition_chaos_downtime_s",
+     ("partition_chaos", "downtime_s"), "lower"),
+    ("partition_chaos_double_executions",
+     ("partition_chaos", "double_executions"), "zero"),
+    ("partition_chaos_absorbed_rate",
+     ("partition_chaos", "absorbed_rate"), "higher"),
 )
 
 
@@ -126,7 +137,9 @@ def compare(old: dict, new: dict, threshold_pct: float = 20.0) -> dict:
             continue
         delta = _pct(o, n)
         regressed = False
-        if delta is not None:
+        if direction == "zero":
+            regressed = n > 0
+        elif delta is not None:
             if direction == "higher":
                 regressed = n < o * (1.0 - threshold_pct / 100.0)
             else:
