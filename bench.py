@@ -309,6 +309,7 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
                     profiling: bool = True,
                     anomaly: bool = True,
                     waterfall: bool = True,
+                    fleet_observatory: bool = True,
                     **host_path) -> dict:
     """TpuBalancer.publish() end-to-end on the in-memory bus with echo
     invokers: the full host path (slot alloc, micro-batch assembly, device
@@ -350,6 +351,12 @@ def _balancer_bench(n_invokers: int = 16, total: int = 2000,
         # true no-op and the ON half starts from clean aggregates
         GLOBAL_WATERFALL.enabled = waterfall
         GLOBAL_WATERFALL.reset()
+        # the event log is process-global like the waterfall; structural
+        # events are rare by design, so the ON half measures the ambient
+        # cost of the armed plane (the `enabled` branch at call sites)
+        from openwhisk_tpu.utils.eventlog import GLOBAL_EVENT_LOG
+        GLOBAL_EVENT_LOG.enabled = fleet_observatory
+        GLOBAL_EVENT_LOG.reset()
         await bal.start()
         feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
         # wait until supervision has actually registered the fleet (a fixed
@@ -650,14 +657,23 @@ def _balancer_host_rows() -> Optional[dict]:
 
 def _plane_overhead(flag: str, key: str, repeats: int = 3, total: int = 1000,
                     concurrency: int = 64) -> Optional[dict]:
-    """The observability tax, shared rider body: median XLA-kernel
+    """The observability tax, shared rider body: best XLA-kernel
     placement rate through the full balancer path with one plane ON vs
     OFF. Every plane lives somewhere on the dispatch/completion path, so
     the balancer-level rate — not the raw kernel step — is where its cost
     can show. `flag` is the _balancer_bench kwarg that toggles the plane,
     `key` names the result fields (`rate_{key}_on/off`). Acceptance gate
-    for each plane: overhead_pct <= 5 (ISSUEs 1-4)."""
+    for each plane: overhead_pct <= 5 (ISSUEs 1-4, 16).
+
+    Each arm is judged by its BEST repeat after one discarded warmup run:
+    throughput noise on a shared host is one-sided (GC, scheduling and
+    first-compile hiccups only ever slow a run down, never speed it up),
+    so best-of-N converges on the true marginal cost where a median of 3
+    can report a double-digit phantom overhead for a plane that provably
+    records nothing on the measured path."""
     try:
+        _balancer_bench(total=total, concurrency=concurrency,
+                        kernel="xla", **{flag: False})  # warmup, discarded
         on_rates, off_rates = [], []
         for _ in range(repeats):
             on_rates.append(_balancer_bench(
@@ -666,13 +682,14 @@ def _plane_overhead(flag: str, key: str, repeats: int = 3, total: int = 1000,
             off_rates.append(_balancer_bench(
                 total=total, concurrency=concurrency, kernel="xla",
                 **{flag: False})["activations_per_sec"])
-        on = statistics.median(on_rates)
-        off = statistics.median(off_rates)
+        on = max(on_rates)
+        off = max(off_rates)
         return {
             f"rate_{key}_on": round(on, 1),
             f"rate_{key}_off": round(off, 1),
             "overhead_pct": round(100.0 * (off - on) / off, 2) if off else None,
             "repeats": repeats,
+            "agg": "best_of_n_after_warmup",
         }
     except Exception as e:  # noqa: BLE001 — rider is auxiliary
         if _backend_unavailable(e):
@@ -705,6 +722,111 @@ def _waterfall_overhead(**kw) -> Optional[dict]:
     """ISSUE 7 gate: per-activation stage stamping must cost <= 5% through
     the full balancer path (same protocol as the other four planes)."""
     return _plane_overhead("waterfall", "waterfall", **kw)
+
+
+def _fleet_observatory_overhead(repeats: int = 20, total: int = 1000,
+                                concurrency: int = 64) -> Optional[dict]:
+    """ISSUE 16 gate: the fleet observatory is scrape-pull-only — with no
+    scraper attached its steady-state cost is the armed EventLog (one
+    bool branch at structural call sites, which a placement-only bench
+    never even takes), so the expected overhead is ~0.
+
+    That makes the shared `_plane_overhead` protocol (fresh fixture per
+    arm per repeat) the wrong instrument: on a shared host the balancer
+    rate swings 4x run-to-run, and a between-run comparison of a ~0%
+    effect reports pure noise with either sign. This rider instead builds
+    the fixture ONCE and alternates armed/disarmed measured segments
+    back-to-back inside the same process — each pair shares the host's
+    momentary throughput mode, so the paired ratio isolates the plane's
+    marginal cost. Segment order flips every repeat to cancel drift.
+    Individual pairs still carry tens-of-percent host jitter at ~0.5 s
+    segment lengths, so the verdict is a 20%-trimmed mean over many
+    pairs — per-pair noise is zero-mean once paired, and the trim guards
+    the tails a mean can't."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.eventlog import GLOBAL_EVENT_LOG
+    from openwhisk_tpu.utils.transaction import TransactionId
+
+    async def go() -> dict:
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          kernel="xla")
+        await bal.start()
+        feeds, stop_fleet = await _echo_fleet(provider, 16)
+        from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= 16:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("fleet observatory rider: fleet unhealthy")
+
+        actions = [_bench_action(f"fo{i}", memory=128) for i in range(8)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            action = actions[i % len(actions)]
+            msg = ActivationMessage(
+                TransactionId(), action.fully_qualified_name, action.rev.rev,
+                ident, ActivationId.generate(), ControllerInstanceId("0"),
+                True, {})
+            async with sem:
+                promise = await bal.publish(action, msg)
+                await promise
+
+        async def segment() -> float:
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one(i) for i in range(total)])
+            return total / (time.perf_counter() - t0)
+
+        try:
+            # warmup: compile + settle before any measured segment
+            await segment()
+            was = GLOBAL_EVENT_LOG.enabled
+            pairs = []
+            on_rates, off_rates = [], []
+            for k in range(repeats):
+                order = (True, False) if k % 2 == 0 else (False, True)
+                rate = {}
+                for armed in order:
+                    GLOBAL_EVENT_LOG.enabled = armed
+                    GLOBAL_EVENT_LOG.reset()
+                    rate[armed] = await segment()
+                GLOBAL_EVENT_LOG.enabled = was
+                on_rates.append(rate[True])
+                off_rates.append(rate[False])
+                pairs.append(100.0 * (rate[False] - rate[True])
+                             / rate[False])
+        finally:
+            await stop_fleet()
+            await bal.close()
+            for f in feeds:
+                await f.stop()
+        trim = max(1, len(pairs) // 5)
+        kept = sorted(pairs)[trim:-trim] if len(pairs) > 2 * trim else pairs
+        return {
+            "rate_fleet_observatory_on": round(max(on_rates), 1),
+            "rate_fleet_observatory_off": round(max(off_rates), 1),
+            "overhead_pct": round(statistics.mean(kept), 2),
+            "pair_overheads_pct": [round(p, 2) for p in pairs],
+            "repeats": repeats,
+            "agg": "trimmed_mean_paired_segments",
+        }
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# fleet_observatory_overhead failed: {e!r}", file=sys.stderr)
+        return None
 
 
 def _e2e_open_loop_measure(rate0: float = 32.0, duration: float = 2.5,
@@ -790,6 +912,27 @@ def _e2e_fleet_mesh_measure(rate0: float = 32.0, duration: float = 2.0,
     return keep
 
 
+def _e2e_multiproc_measure(rate: float = 128.0, procs: int = 2,
+                           duration: float = 1.5) -> Optional[dict]:
+    """The --procs fleet-merged point (ISSUE 16): N worker generators at
+    rate/N each, the parent reaping ONE fleet-merged host snapshot (raw
+    integer bucket counts merged bucket-wise, the federation's own merge
+    math) instead of N per-worker blobs. The kept headline is
+    fleet_merged_sustained_per_sec — gated in tools/bench_compare.py."""
+    from tools.loadgen import multiproc_fixed_rate
+    row = multiproc_fixed_rate(rate=rate, procs=procs, duration=duration,
+                               host_observatory=True)
+    keep = {k: row.get(k) for k in (
+        "mode", "procs", "sustained", "sustained_activations_per_sec",
+        "fleet_merged_sustained_per_sec", "offered_rate", "p50_ms",
+        "p99_ms")}
+    hf = row.get("host_fleet") or {}
+    keep["host_fleet_members"] = hf.get("members")
+    keep["host_fleet_lag_p99_le_ms"] = (hf.get("loop_lag")
+                                        or {}).get("p99_le_ms")
+    return keep
+
+
 def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
                    max_doublings: int = 9) -> Optional[dict]:
     """The ISSUE 7 headline rider: open-loop offered-rate sweep against the
@@ -821,6 +964,14 @@ def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
         if mesh is not None:
             mesh["backend"] = "cpu"
             out["fleet_mesh_point"] = mesh
+        # --procs fleet-merged point (ISSUE 16): the parent reaps ONE
+        # merged snapshot across its worker generators; headline gates
+        # as fleet_merged_sustained_per_sec in bench_compare
+        mp = _cpu_subprocess_json("bench._e2e_multiproc_measure()",
+                                  "RIDERJSON", "e2e multiproc point")
+        if mp is not None:
+            mp["backend"] = "cpu"
+            out["multiproc_point"] = mp
         cmp_block = _compared_to("e2e_open_loop", out)
         if cmp_block is not None:
             out["compared_to"] = cmp_block
@@ -1928,6 +2079,15 @@ def _partition_chaos(rate: float = 64.0, duration: float = 3.0,
     async def go() -> dict:
         tmp = tempfile.mkdtemp(prefix="partition-chaos-")
         provider = MemoryMessagingProvider()
+        # fleet observatory (ISSUE 16): all three in-process controllers
+        # record into the shared process-global event log (call sites
+        # stamp their own instance=), so the kill->silence->claim->
+        # absorb->first-placement timeline reconstructs from ONE mono
+        # clock and its phase durations telescope exactly
+        from openwhisk_tpu.utils.eventlog import GLOBAL_EVENT_LOG
+        event_log_was = GLOBAL_EVENT_LOG.enabled
+        GLOBAL_EVENT_LOG.enabled = True
+        GLOBAL_EVENT_LOG.reset()
         executed, fenced, fleet_stop = await fenced_echo_fleet(
             provider, n_invokers)
 
@@ -2054,6 +2214,10 @@ def _partition_chaos(rate: float = 64.0, duration: float = 3.0,
                 vb.journal = None
                 dead.add(victim)
                 t_kill = time.monotonic()
+                GLOBAL_EVENT_LOG.record("chaos_kill", instance=victim,
+                                        parts=sorted(
+                                            memberships[victim]
+                                            .owned_partitions))
             tasks.append(asyncio.ensure_future(one(i)))
         done = await asyncio.gather(*tasks)
 
@@ -2127,6 +2291,29 @@ def _partition_chaos(rate: float = 64.0, duration: float = 3.0,
         if t_post and t_claimed:
             downtime_s = round(max(t_post.values()) - t_claimed, 3)
 
+        # reconstructed causal timeline (ISSUE 16): decompose the outage
+        # into named phases from the recorded structural events. All
+        # marks share one process's monotonic clock, so detect + claim +
+        # absorb + first_placement sums to the timeline's own
+        # (first_placement - kill) downtime EXACTLY; it is reported
+        # beside the service-probe downtime above, which measures with
+        # probe-loop granularity.
+        from openwhisk_tpu.controller.monitoring import reconstruct_phases
+        chaos_events = GLOBAL_EVENT_LOG.recent()
+        timeline = reconstruct_phases(chaos_events)
+        kill_mono = next((e["mono"] for e in chaos_events
+                          if e["kind"] == "chaos_kill"), None)
+        timeline["events"] = [
+            {"kind": e["kind"], "instance": e.get("instance"),
+             "t_s": round(e["mono"] - kill_mono, 4)}
+            for e in chaos_events
+            if kill_mono is not None and e["mono"] >= kill_mono
+            and e["kind"] in ("chaos_kill", "member_silent", "part_claim",
+                              "part_ownership", "absorb_start",
+                              "absorb_end", "first_placement",
+                              "fence_discard")]
+        GLOBAL_EVENT_LOG.enabled = event_log_was
+
         for i, m in memberships.items():
             if i != victim:
                 await m.stop()
@@ -2141,6 +2328,7 @@ def _partition_chaos(rate: float = 64.0, duration: float = 3.0,
         return {
             "downtime_s": downtime_s,
             "detection_s": detection_s,
+            "timeline": timeline,
             "double_executions": dup_execs,
             "absorbed_rate": round(
                 len(survivors_owned & victim_parts)
@@ -2362,6 +2550,7 @@ def _run(args) -> Optional[dict]:
     profiling_overhead = None
     anomaly_overhead = None
     waterfall_overhead = None
+    fleet_observatory_overhead = None
     e2e_open_loop = None
     repair_vs_scan = None
     pipeline_speedup = None
@@ -2390,6 +2579,10 @@ def _run(args) -> Optional[dict]:
                                       _partition_chaos)
         waterfall_overhead = timed_rider("_waterfall_overhead",
                                          _waterfall_overhead)
+        # ISSUE 16: the armed-EventLog ambient cost (scrape-pull-only
+        # federation, so steady state should measure ~0)
+        fleet_observatory_overhead = timed_rider(
+            "_fleet_observatory_overhead", _fleet_observatory_overhead)
         repair_vs_scan = timed_rider("_repair_vs_scan", _repair_vs_scan)
         # ROADMAP item 2: placement rate per fleet size over the
         # ('fleet',) mesh (the MULTICHIP dryrun folded into the bench)
@@ -2507,6 +2700,8 @@ def _run(args) -> Optional[dict]:
         out["anomaly_overhead"] = anomaly_overhead
     if waterfall_overhead is not None:
         out["waterfall_overhead"] = waterfall_overhead
+    if fleet_observatory_overhead is not None:
+        out["fleet_observatory_overhead"] = fleet_observatory_overhead
     if host_profiling_overhead is not None:
         out["host_profiling_overhead"] = host_profiling_overhead
     if host_observatory is not None:
@@ -2528,7 +2723,8 @@ def _run(args) -> Optional[dict]:
     if any(isinstance(r, dict) and r.get("backend") == "cpu_fallback"
            for r in (recorder_overhead, telemetry_overhead,
                      profiling_overhead, anomaly_overhead,
-                     waterfall_overhead, e2e_open_loop,
+                     waterfall_overhead, fleet_observatory_overhead,
+                     e2e_open_loop,
                      repair_vs_scan, pipeline_speedup,
                      bus_coalesce_speedup, failover_downtime,
                      partition_chaos, sharded_fleet_sweep,

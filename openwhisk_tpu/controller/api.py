@@ -141,6 +141,17 @@ class ControllerApi:
         # active), global role (active/standby), journal stall state —
         # 200 iff this controller is placing for something (auth-gated)
         r.add_get("/admin/ready", self.admin_ready)
+        # fleet observatory (ISSUE 16): the raw exact-merge exports
+        # (integer bucket counts, never percentiles) plus the federated
+        # cross-process views scraped from the live peer directory.
+        # Auth-gated like the rest of /admin; every handler answers 404
+        # while CONFIG_whisk_fleetObservatory_enabled=false.
+        r.add_get("/admin/metrics/raw", self.metrics_raw)
+        r.add_get("/admin/fleet/metrics", self.fleet_metrics)
+        r.add_get("/admin/fleet/waterfall", self.fleet_waterfall)
+        r.add_get("/admin/fleet/slo", self.fleet_slo)
+        r.add_get("/admin/fleet/host", self.fleet_host)
+        r.add_get("/admin/fleet/timeline", self.fleet_timeline)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -419,12 +430,16 @@ class ControllerApi:
         lb = self.c.load_balancer
         if hasattr(lb, "_telemetry_invoker_names"):
             names = lb._telemetry_invoker_names()
+        # ?raw=1: the label-keyed exact-merge export the fleet federation
+        # scrapes (integer bucket/outcome counts, no verdicts)
+        raw = request.query.get("raw", "").lower() in ("1", "true", "yes")
+        fn = tp.raw_counts if raw else tp.slo_report
         if tp.SYNCS_DEVICE:
             # reading device counts forces a device sync — worker thread,
             # same policy as the occupancy endpoint
-            report = await asyncio.to_thread(tp.slo_report, names)
+            report = await asyncio.to_thread(fn, names)
         else:
-            report = tp.slo_report(names)
+            report = fn(names)
         return web.json_response(report)
 
     async def profile_kernel(self, request):
@@ -488,6 +503,9 @@ class ControllerApi:
         (the capture endpoint returns a full-rate bounded window
         instead)."""
         from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY as obs
+        if request.query.get("raw", "").lower() in ("1", "true", "yes"):
+            # the exact-merge export the fleet federation scrapes
+            return web.json_response(obs.raw_counts())
         snap = obs.snapshot()
         if snap.get("enabled") and request.query.get(
                 "collapsed", "").lower() in ("1", "true", "yes"):
@@ -622,9 +640,14 @@ class ControllerApi:
                           request.get("transid"))
         try:
             recent = max(0, int(request.query.get("recent", 0)))
+            rows = max(0, int(request.query.get("rows", 0)))
         except ValueError:
-            return _error(400, "recent must be an integer",
+            return _error(400, "recent/rows must be integers",
                           request.get("transid"))
+        if request.query.get("raw", "").lower() in ("1", "true", "yes"):
+            # exact-merge export: bucket counts + ring rows (the fleet
+            # merger joins spill_forward halves from the rows)
+            return web.json_response(wf.raw_counts(rows=rows))
         report = wf.report(recent=recent)
         fr = self._flight_recorder()
         if fr is not None and report.get("enabled"):
@@ -640,6 +663,172 @@ class ControllerApi:
                         "timings": batch.get("timings", {}),
                     }
         return web.json_response(report)
+
+    # ------------------------------------------------- fleet observatory
+    #: ring rows each member ships for the spill_forward join — enough to
+    #: pair both halves of recent spilled activations without making the
+    #: scrape payload unbounded
+    FLEET_WATERFALL_ROWS = 256
+
+    def _fleet_cfg(self):
+        cfg = getattr(self.c, "fleet_config", None)
+        return cfg if (cfg is not None and cfg.enabled) else None
+
+    def _fleet_disabled(self, request):
+        return _error(404, "the fleet observatory is disabled "
+                      "(CONFIG_whisk_fleetObservatory_enabled=false)",
+                      request.get("transid"))
+
+    async def _fleet_scrape(self, request, cfg, path, extra=None):
+        """Scrape `path` from every live peer (+ `extra` static members).
+        The caller's Authorization header travels with the scrape: the
+        controllers share the auth store, so the credential that opened
+        this endpoint opens the peers'."""
+        from .fleet import FleetScraper
+        members = {}
+        mem = self.c.membership
+        if mem is not None:
+            members.update(mem.peer_directory())
+        if extra:
+            members.update(extra)
+        return await FleetScraper(cfg).scrape(
+            members, path, request.headers.get("Authorization"))
+
+    async def metrics_raw(self, request):
+        """The MetricEmitter snapshot in the federation wire shape —
+        counters/gauges/histogram-lifetime rows with serialized series
+        keys (what /admin/fleet/metrics scrapes from each peer)."""
+        if self._fleet_cfg() is None:
+            return self._fleet_disabled(request)
+        from ..utils.eventlog import identity
+        from .monitoring import metrics_raw
+        return web.json_response(
+            metrics_raw(self.c.metrics.snapshot(), identity()))
+
+    async def fleet_metrics(self, request):
+        """Fleet-merged metrics: counters sum across the live peer
+        directory (plus the configured edge proxy), histogram lifetime
+        count/sum merge, gauges stay per-member. Partial results are
+        labeled via `members_missing`, never a non-200."""
+        cfg = self._fleet_cfg()
+        if cfg is None:
+            return self._fleet_disabled(request)
+        from ..utils.eventlog import identity
+        from .monitoring import merged_metrics, metrics_raw
+        local = metrics_raw(self.c.metrics.snapshot(), identity())
+        peers, missing = await self._fleet_scrape(
+            request, cfg, "/admin/metrics/raw")
+        raws = [local] + [peers[k] for k in sorted(peers)]
+        if cfg.edge_url:
+            # the edge is one more member: its /admin/edge/stats exports
+            # the same counter-row wire shape (plus human-readable extras
+            # the merge ignores)
+            eres, emiss = await self._fleet_scrape(
+                request, cfg, "/admin/edge/stats",
+                extra={"edge": cfg.edge_url})
+            raws += [eres[k] for k in sorted(eres) if k == "edge"]
+            missing += [k for k in emiss if k == "edge"]
+        body = merged_metrics(raws)
+        body["members_missing"] = missing
+        return web.json_response(body)
+
+    async def fleet_waterfall(self, request):
+        """Fleet-merged latency waterfall: per-stage log2 histograms sum
+        bucket-wise bit-exactly, spilled activations' origin/peer ring
+        rows join into one telescoping row, then the ordinary waterfall
+        report renders over the merged counts. `?recent=N` as on the
+        per-process endpoint."""
+        cfg = self._fleet_cfg()
+        if cfg is None:
+            return self._fleet_disabled(request)
+        from .monitoring import merged_waterfall_report
+        try:
+            recent = max(0, int(request.query.get("recent", 0)))
+        except ValueError:
+            return _error(400, "recent must be an integer",
+                          request.get("transid"))
+        raws = []
+        wf = getattr(self.c.load_balancer, "waterfall", None)
+        if wf is not None:
+            raws.append(wf.raw_counts(rows=self.FLEET_WATERFALL_ROWS))
+        peers, missing = await self._fleet_scrape(
+            request, cfg,
+            f"/admin/latency/waterfall?raw=1&rows={self.FLEET_WATERFALL_ROWS}")
+        raws += [peers[k] for k in sorted(peers)]
+        body = merged_waterfall_report(raws, recent=recent)
+        body["members_missing"] = missing
+        return web.json_response(body)
+
+    async def fleet_slo(self, request):
+        """Fleet-merged SLO verdicts: per-namespace / per-invoker bucket
+        and outcome counts merge by label across members, then the SAME
+        judge math as the per-process plane re-judges burn and budget
+        over the MERGED histograms — a fleet-level p99 from counts, not
+        an average of per-process p99s."""
+        cfg = self._fleet_cfg()
+        if cfg is None:
+            return self._fleet_disabled(request)
+        from .monitoring import merged_slo_report
+        raws = []
+        lb = self.c.load_balancer
+        tp = getattr(lb, "telemetry", None)
+        if tp is not None:
+            names = []
+            if hasattr(lb, "_telemetry_invoker_names"):
+                names = lb._telemetry_invoker_names()
+            if tp.SYNCS_DEVICE:
+                raws.append(await asyncio.to_thread(tp.raw_counts, names))
+            else:
+                raws.append(tp.raw_counts(names))
+        peers, missing = await self._fleet_scrape(
+            request, cfg, "/admin/slo?raw=1")
+        raws += [peers[k] for k in sorted(peers)]
+        body = merged_slo_report(raws)
+        body["members_missing"] = missing
+        return web.json_response(body)
+
+    async def fleet_host(self, request):
+        """Fleet-merged host observatory: loop-lag / GC histograms sum
+        bucket-wise, stall/task/serde counters sum, percentiles
+        re-derive from the merged counts."""
+        cfg = self._fleet_cfg()
+        if cfg is None:
+            return self._fleet_disabled(request)
+        from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY as obs
+        from .monitoring import merged_host_report
+        raws = [obs.raw_counts()]
+        peers, missing = await self._fleet_scrape(
+            request, cfg, "/admin/profile/host?raw=1")
+        raws += [peers[k] for k in sorted(peers)]
+        body = merged_host_report(raws)
+        body["members_missing"] = missing
+        return web.json_response(body)
+
+    async def fleet_timeline(self, request):
+        """The merged causal cluster event timeline: this controller's
+        event log plus every peer's records folded from the `ctrlevents`
+        topic (bus-fed, no scrape), ordered by wall clock with (mono,
+        seq) tie-breaks. `?limit=N` keeps the newest N events."""
+        cfg = self._fleet_cfg()
+        if cfg is None:
+            return self._fleet_disabled(request)
+        from ..utils.eventlog import GLOBAL_EVENT_LOG
+        from .monitoring import merged_timeline
+        try:
+            limit = max(0, int(request.query.get("limit", 0)))
+        except ValueError:
+            return _error(400, "limit must be an integer",
+                          request.get("transid"))
+        fe = getattr(self.c, "fleet_events", None)
+        if fe is not None:
+            events = fe.events_by_member()
+        else:
+            inst = getattr(getattr(self.c, "instance", None), "instance", None)
+            events = {inst if inst is not None else "local":
+                      GLOBAL_EVENT_LOG.recent()}
+        body = merged_timeline(events, limit=limit)
+        body["evicted"] = GLOBAL_EVENT_LOG.evicted
+        return web.json_response(body)
 
     async def placement_occupancy(self, request):
         """Per-invoker slots-in-use/capacity derived from the balancer

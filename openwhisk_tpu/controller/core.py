@@ -106,6 +106,13 @@ class Controller:
         self.ha_partition_ring = None
         self.on_partitions = None
         self.spillover_receiver = None
+        # fleet observatory (ISSUE 16): resolved once at assembly; start()
+        # wires the admin-address announcement, the identity block and the
+        # ctrlevents publisher only when enabled, so disabled stays a TRUE
+        # no-op (byte-exact heartbeats, no topic, endpoints 404)
+        from ..utils.eventlog import fleet_config
+        self.fleet_config = fleet_config()
+        self.fleet_events = None
 
     # -- rule status handling (status lives on the trigger doc) ------------
     async def rule_status(self, rule) -> str:
@@ -135,6 +142,24 @@ class Controller:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 3233) -> None:
+        # fleet observatory identity: who this process is in every
+        # snapshot the federation merges (partitions resolve live from
+        # the balancer so the block tracks ownership changes)
+        from ..utils.eventlog import GLOBAL_EVENT_LOG, set_identity
+        fleet_on = self.fleet_config.enabled
+        GLOBAL_EVENT_LOG.enabled = fleet_on
+        if fleet_on:
+            lb_ = self.load_balancer
+
+            def owned_parts():
+                if getattr(lb_, "partition_ring", None) is not None:
+                    return [p["partition"] for p in lb_.partitions_json()
+                            if p["role"] == "active"]
+                return []
+
+            set_identity(instance=self.instance.instance, role="controller",
+                         partitions_fn=owned_parts)
+        admin_url = f"http://{host}:{port}" if fleet_on else None
         # host hot-loop observatory (utils/hostprof.py): event-loop lag,
         # GC pauses, task churn/serde accounting and the sampling profiler
         # arm on THIS controller's loop; the renderer joins this
@@ -184,8 +209,17 @@ class Controller:
                 ring=self.ha_partition_ring,
                 on_partitions=self.on_partitions,
                 load_hint=(load_hint if self.ha_partition_ring is not None
-                           else None))
+                           else None),
+                admin_url=admin_url)
             self.membership.start()
+        if fleet_on:
+            # structural events -> ctrlevents topic, peers' frames folded
+            # for the merged /admin/fleet/timeline
+            from .fleet import FleetEvents
+            self.fleet_events = FleetEvents(
+                self.provider, self.instance.instance,
+                config=self.fleet_config, logger=self.logger)
+            self.fleet_events.start()
         if self.spillover_receiver is not None:
             self.spillover_receiver.start()
         app = self.api.make_app()
@@ -207,6 +241,9 @@ class Controller:
             await self._runner.cleanup()
         if self.membership is not None:
             await self.membership.stop()  # sends the graceful leave
+        if self.fleet_events is not None:
+            await self.fleet_events.stop()
+            self.fleet_events = None
         if self.spillover_receiver is not None:
             await self.spillover_receiver.stop()
         for resource in self.owned_resources:

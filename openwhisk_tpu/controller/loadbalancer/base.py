@@ -29,6 +29,7 @@ from ...messaging.columnar import is_batch_payload
 from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
                                   parse_ack)
 from ...utils.config import load_config
+from ...utils.eventlog import GLOBAL_EVENT_LOG
 from ...utils.logging import MetricEmitter
 from ...utils.tracing import trace_id_of
 from ...utils.transaction import TransactionId
@@ -225,6 +226,10 @@ class CommonLoadBalancer(LoadBalancer):
         self.owned_partitions: set = set()
         #: pid -> "replaying" | "ready" (the /admin/ready replay-state)
         self.partition_replay: Dict[int, str] = {}
+        #: partitions gained but not yet dispatched into — the fleet
+        #: timeline's `first_placement` marker (ISSUE 16). Empty-set check
+        #: on the hot path; empty whenever the event log is off.
+        self._fp_pending: set = set()
         #: batch-shaped completion pipeline (ISSUE 12): a batch wire ack
         #: frame is processed in ONE pass (entries, telemetry, waterfall
         #: folds) instead of N per-ack callback hops. False replays each
@@ -406,6 +411,9 @@ class CommonLoadBalancer(LoadBalancer):
             if journal is not None and hasattr(journal, "abandon"):
                 journal.abandon()
         self.ha_standby = not active
+        GLOBAL_EVENT_LOG.record("leadership",
+                                instance=self.controller.instance,
+                                epoch=int(epoch), active=bool(active))
         self.metrics.gauge("controller_leadership_epoch", int(epoch))
         if self.logger:
             self.logger.info(
@@ -432,9 +440,19 @@ class CommonLoadBalancer(LoadBalancer):
         if active:
             self.owned_partitions.add(pid)
             self.partition_replay.setdefault(pid, "ready")
+            if GLOBAL_EVENT_LOG.enabled:
+                # arm the timeline's first-placement marker for this
+                # partition: prepare_dispatch stamps it on the first
+                # post-claim dispatch (ISSUE 16 phase decomposition)
+                self._fp_pending.add(pid)
         else:
             self.owned_partitions.discard(pid)
             self.partition_replay.pop(pid, None)
+            self._fp_pending.discard(pid)
+        GLOBAL_EVENT_LOG.record("part_ownership",
+                                instance=self.controller.instance,
+                                part=pid, epoch=int(epoch),
+                                active=bool(active))
         self.metrics.gauge("loadbalancer_owned_partitions",
                            len(self.owned_partitions))
         if self.logger:
@@ -495,6 +513,11 @@ class CommonLoadBalancer(LoadBalancer):
                                    or ep >= msg.fence_epoch):
                 msg.fence_epoch = ep
                 msg.fence_part = pid
+            if self._fp_pending and pid in self._fp_pending:
+                self._fp_pending.discard(pid)
+                GLOBAL_EVENT_LOG.record("first_placement",
+                                        instance=self.controller.instance,
+                                        part=pid, epoch=ep or 0)
         elif self.fence_epoch is not None:
             # epoch fencing: invokers discard messages from a superseded
             # epoch, so a zombie active's late batches never double-run

@@ -47,6 +47,7 @@ from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
 from ...utils.config import load_config
+from ...utils.eventlog import GLOBAL_EVENT_LOG
 
 _MAGIC = b"WJ"
 _HEADER = struct.Struct("<2sII")
@@ -186,6 +187,9 @@ class PlacementJournal:
                     self._lock.notify_all()
                     if not self._lock.wait(max(0.0, deadline
                                                - time.monotonic())):
+                        GLOBAL_EVENT_LOG.record(
+                            "journal_stall", timeout_s=timeout,
+                            lag_batches=self._appended - self._durable)
                         return False
                 finally:
                     self._flush_waiters -= 1
@@ -310,6 +314,8 @@ class PlacementJournal:
                                      "Journal")
                 with open(path, "r+b") as f:
                     f.truncate(good)
+                GLOBAL_EVENT_LOG.record("journal_truncate",
+                                        bytes_dropped=len(data) - good)
                 self._bytes -= len(data) - good
         self._start_segment(first_seq)
 
@@ -419,6 +425,9 @@ class PlacementJournal:
                 removed += 1
             except OSError:
                 break
+        if removed:
+            GLOBAL_EVENT_LOG.record("journal_prune", segments=removed,
+                                    upto_seq=int(upto_seq))
         return removed
 
     # -- observability -----------------------------------------------------

@@ -58,6 +58,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ...messaging.connector import MessageFeed
+from ...utils.eventlog import GLOBAL_EVENT_LOG
 from ...utils.scheduler import Scheduler
 from ...utils.tasks import spawn
 from ...utils.transaction import TransactionId
@@ -76,7 +77,8 @@ class ControllerMembership:
                  heartbeat_s: float = HEARTBEAT_S,
                  member_timeout_s: float = MEMBER_TIMEOUT_S,
                  ha: bool = False, on_leadership=None,
-                 ring=None, on_partitions=None, load_hint=None):
+                 ring=None, on_partitions=None, load_hint=None,
+                 admin_url: Optional[str] = None):
         self.provider = messaging_provider
         self.instance = instance
         self.balancer = balancer
@@ -108,6 +110,12 @@ class ControllerMembership:
         self._powner: Dict[int, Optional[int]] = {}  # claimed owner per pid
         self._owned: Set[int] = set()
         self.peer_loads: Dict[int, float] = {}
+        #: fleet observatory peer directory (ISSUE 16): admin_url=None is
+        #: the off-switch — heartbeats stay byte-exact with pre-16 builds.
+        #: When set, every heartbeat announces it and peers fold theirs
+        #: into `peer_admin`, the live map /admin/fleet/* scrapes from.
+        self.admin_url = admin_url
+        self.peer_admin: Dict[int, str] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -164,6 +172,10 @@ class ControllerMembership:
         if kind == "leave":
             self._last_seen.pop(inst, None)
             self.peer_loads.pop(inst, None)
+            self.peer_admin.pop(inst, None)
+            GLOBAL_EVENT_LOG.record("member_leave",
+                                    instance=self.instance.instance,
+                                    peer=inst)
             if self.ha and inst == self._lead_instance:
                 # a graceful active departure frees the claim immediately:
                 # age its lease out so the next tick elects without the
@@ -176,6 +188,13 @@ class ControllerMembership:
         else:
             joined = inst not in self._last_seen
             self._last_seen[inst] = time.monotonic()
+            admin = msg.get("admin")
+            if isinstance(admin, str) and admin:
+                self.peer_admin[inst] = admin
+            if joined:
+                GLOBAL_EVENT_LOG.record("member_join",
+                                        instance=self.instance.instance,
+                                        peer=inst)
             if self.ha and msg.get("active"):
                 self._observe_claim(int(msg.get("epoch", 0)), inst)
             if self.ring is not None:
@@ -208,6 +227,8 @@ class ControllerMembership:
                     hb["load"] = float(self.load_hint())
                 except Exception:  # noqa: BLE001 — a hint, never a blocker
                     pass
+        if self.admin_url:
+            hb["admin"] = self.admin_url
         return json.dumps(hb).encode()
 
     async def _tick(self) -> None:
@@ -228,7 +249,13 @@ class ControllerMembership:
         dead = [i for i, ts in self._last_seen.items()
                 if now - ts > self.member_timeout_s]
         for i in dead:
-            del self._last_seen[i]
+            silence_s = now - self._last_seen.pop(i)
+            self.peer_admin.pop(i, None)
+            # silence-detect: the first named phase of the failover
+            # timeline (kill -> detect -> claim -> absorb -> placement)
+            GLOBAL_EVENT_LOG.record("member_silent",
+                                    instance=self.instance.instance,
+                                    peer=i, silence_s=round(silence_s, 4))
         # refold every tick: it no-ops when the size is unchanged, and also
         # converges the case where a seeded peer never appeared at all once
         # the boot grace window lapses
@@ -265,6 +292,9 @@ class ControllerMembership:
                 TransactionId.LOADBALANCER,
                 f"claiming placement leadership: epoch {self._lead_epoch} "
                 f"(instance {self.instance.instance})", "Membership")
+        GLOBAL_EVENT_LOG.record("lead_claim",
+                                instance=self.instance.instance,
+                                epoch=self._lead_epoch)
         self._export_epoch()
         # announce immediately — peers demote/stand down without waiting
         # out a heartbeat interval
@@ -302,6 +332,9 @@ class ControllerMembership:
                     TransactionId.LOADBALANCER,
                     f"leadership superseded by instance {inst} epoch "
                     f"{epoch}; demoting to standby", "Membership")
+            GLOBAL_EVENT_LOG.record("lead_superseded",
+                                    instance=self.instance.instance,
+                                    by=inst, epoch=epoch)
             self._fire_leadership(False)
         self._export_epoch()
 
@@ -333,6 +366,9 @@ class ControllerMembership:
                     f"partition {pid} ownership superseded by instance "
                     f"{inst} epoch {epoch}; demoting that partition",
                     "Membership")
+            GLOBAL_EVENT_LOG.record("part_superseded",
+                                    instance=self.instance.instance,
+                                    part=pid, by=inst, epoch=epoch)
             self._fire_partitions(gained=[], lost=[(pid, epoch)])
 
     async def _partition_tick(self, now: float) -> None:
@@ -365,6 +401,11 @@ class ControllerMembership:
                     TransactionId.LOADBALANCER,
                     f"claiming partitions {[p for p, _, _ in gained]} "
                     f"(instance {me})", "Membership")
+            GLOBAL_EVENT_LOG.record(
+                "part_claim", instance=me,
+                parts={str(p): e for p, e, _ in gained},
+                prev={str(p): prev for p, _, prev in gained
+                      if prev is not None})
             # announce immediately — peers demote / stop claiming without
             # waiting out a heartbeat interval
             try:
@@ -394,6 +435,17 @@ class ControllerMembership:
         if not live:
             return None
         return min(live, key=lambda i: (self.peer_loads.get(i, 0.0), i))
+
+    def peer_directory(self) -> Dict[int, str]:
+        """Live peers with a known admin address: {instance: admin_url}.
+        This is the scrape map behind /admin/fleet/* (ISSUE 16) — peers
+        that never announced an address (observatory off on their side,
+        or a pre-16 build) simply aren't scrapeable and show up in the
+        federation's `members_missing` instead."""
+        now = time.monotonic()
+        return {i: url for i, url in sorted(self.peer_admin.items())
+                if i in self._last_seen
+                and now - self._last_seen[i] <= self.member_timeout_s}
 
     @property
     def owned_partitions(self) -> Set[int]:

@@ -36,6 +36,7 @@ from typing import List, Optional
 from ...core.entity import ControllerInstanceId
 from ...messaging.columnar import ActivationBatchMessage, is_batch_payload
 from ...messaging.connector import MessageFeed, decode_batch
+from ...utils.eventlog import GLOBAL_EVENT_LOG
 from ...utils.transaction import TransactionId
 
 SPILL_TOPIC_PREFIX = "ctrlspill"
@@ -88,6 +89,8 @@ class SpilloverSender:
             self._topics_ensured.add(topic)
         if self.metrics is not None:
             self.metrics.counter("loadbalancer_spillover_batches")
+        GLOBAL_EVENT_LOG.record("spill_burst", peer=int(peer),
+                                rows=len(msgs))
 
         async def _send() -> None:
             try:

@@ -83,6 +83,10 @@ class InvokerPool:
         #: never reads them — a flagged invoker still takes traffic until
         #: real outcome evidence (the ring buffer) demotes it.
         self.unhealthy_hints: Dict[int, str] = {}
+        #: fleet observatory peer directory (ISSUE 16): invoker admin
+        #: addresses announced on their health pings. Empty unless
+        #: invokers run with the observatory enabled and an address set.
+        self.invoker_admin: Dict[int, str] = {}
         self._feed: Optional[MessageFeed] = None
         self._watchdog: Optional[Scheduler] = None
 
@@ -99,6 +103,8 @@ class InvokerPool:
         async def handle(payload: bytes):
             try:
                 ping = PingMessage.parse(payload)
+                if ping.admin:
+                    self.invoker_admin[ping.instance.instance] = ping.admin
                 self.on_ping(ping.instance)
             except (ValueError, KeyError):
                 pass

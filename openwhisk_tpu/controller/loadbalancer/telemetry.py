@@ -40,6 +40,7 @@ from ...ops.telemetry import (DEFAULT_BUCKETS, N_OUTCOMES, OUTCOME_ERROR,
                               OUTCOME_NAMES, OUTCOME_SUCCESS, OUTCOME_TIMEOUT,
                               NumpyLatencyAccumulator, bucket_bounds_ms)
 from ...utils.config import load_config
+from ...utils.eventlog import identity
 
 #: burn-rate windows (seconds): the classic fast/slow alerting pair
 FAST_WINDOW_S = 60.0
@@ -77,6 +78,58 @@ def _override(ov: dict, snake: str, camel: str, default: float) -> float:
     JSON typically arrives camelCase like the env vars themselves)."""
     v = ov.get(snake, ov.get(camel, default))
     return float(v)
+
+
+def _pctl_bucket(counts: np.ndarray, q: float) -> int:
+    """Index of the bucket holding the q-quantile (cumulative walk)."""
+    total = int(counts.sum())
+    target = max(1, int(np.ceil(q * total)))
+    cum = np.cumsum(counts)
+    return int(np.searchsorted(cum, target, side="left"))
+
+
+def judge_scope(buckets, outcomes, bounds: List[float],
+                p99_target_ms: float, err_target: float) -> dict:
+    """One scope's SLO verdict from raw bucket/outcome counts. Module
+    level (no plane instance) so the fleet federation can re-judge
+    burn/budget over MERGED histograms with exactly the math a single
+    process uses — the judgment of the pooled counts, not a vote over
+    per-process verdicts."""
+    buckets = np.asarray(buckets)
+    outcomes = np.asarray(outcomes)
+    total = int(buckets.sum())
+    bad = int(outcomes[OUTCOME_ERROR] + outcomes[OUTCOME_TIMEOUT])
+    err_ratio = (bad / total) if total else 0.0
+    # the SLO is judged at bucket granularity: the target rounds UP to
+    # the bound of the bucket containing it (a 1000 ms target is judged
+    # at le=1024) — comparing the p99 bucket's upper bound against the
+    # raw target would silently tighten any non-power-of-two target to
+    # the next LOWER bound and flag compliant fleets as violating
+    eff_target = next((b for b in bounds if b >= p99_target_ms), None)
+    if total:
+        bi = _pctl_bucket(buckets, 0.99)
+        p99 = bounds[bi] if bi < len(bounds) else None  # None: +Inf bucket
+        latency_ok = p99 is not None and (eff_target is None
+                                          or p99 <= eff_target)
+    else:
+        p99, latency_ok = None, True
+    error_ok = err_ratio <= err_target
+    budget = (max(0.0, 1.0 - err_ratio / max(err_target, 1e-9))
+              if total else 1.0)
+    return {
+        "count": total,
+        "outcomes": {OUTCOME_NAMES[k]: int(outcomes[k])
+                     for k in range(N_OUTCOMES)},
+        "p99_le_ms": p99,
+        "latency_target_ms": p99_target_ms,
+        "latency_target_le_ms": eff_target,
+        "latency_compliant": bool(latency_ok),
+        "error_ratio": round(err_ratio, 6),
+        "error_ratio_target": err_target,
+        "error_ratio_compliant": bool(error_ok),
+        "error_budget_remaining": round(budget, 4),
+        "compliant": bool(latency_ok and error_ok),
+    }
 
 
 class TelemetryPlane:
@@ -321,50 +374,12 @@ class TelemetryPlane:
             self.tick(metrics)
 
     # -- SLO evaluation ----------------------------------------------------
-    @staticmethod
-    def _pctl_bucket(counts: np.ndarray, q: float) -> int:
-        """Index of the bucket holding the q-quantile (cumulative walk)."""
-        total = int(counts.sum())
-        target = max(1, int(np.ceil(q * total)))
-        cum = np.cumsum(counts)
-        return int(np.searchsorted(cum, target, side="left"))
+    _pctl_bucket = staticmethod(_pctl_bucket)
 
     def _scope_report(self, buckets: np.ndarray, outcomes: np.ndarray,
                       p99_target_ms: float, err_target: float) -> dict:
-        bounds = self.bounds_ms()
-        total = int(buckets.sum())
-        bad = int(outcomes[OUTCOME_ERROR] + outcomes[OUTCOME_TIMEOUT])
-        err_ratio = (bad / total) if total else 0.0
-        # the SLO is judged at bucket granularity: the target rounds UP to
-        # the bound of the bucket containing it (a 1000 ms target is judged
-        # at le=1024) — comparing the p99 bucket's upper bound against the
-        # raw target would silently tighten any non-power-of-two target to
-        # the next LOWER bound and flag compliant fleets as violating
-        eff_target = next((b for b in bounds if b >= p99_target_ms), None)
-        if total:
-            bi = self._pctl_bucket(buckets, 0.99)
-            p99 = bounds[bi] if bi < len(bounds) else None  # None: +Inf bucket
-            latency_ok = p99 is not None and (eff_target is None
-                                              or p99 <= eff_target)
-        else:
-            p99, latency_ok = None, True
-        error_ok = err_ratio <= err_target
-        budget = (max(0.0, 1.0 - err_ratio / max(err_target, 1e-9))
-                  if total else 1.0)
-        return {
-            "count": total,
-            "outcomes": {OUTCOME_NAMES[k]: int(outcomes[k])
-                         for k in range(N_OUTCOMES)},
-            "p99_le_ms": p99,
-            "latency_target_ms": p99_target_ms,
-            "latency_target_le_ms": eff_target,
-            "latency_compliant": bool(latency_ok),
-            "error_ratio": round(err_ratio, 6),
-            "error_ratio_target": err_target,
-            "error_ratio_compliant": bool(error_ok),
-            "error_budget_remaining": round(budget, 4),
-            "compliant": bool(latency_ok and error_ok),
-        }
+        return judge_scope(buckets, outcomes, self.bounds_ms(),
+                           p99_target_ms, err_target)
 
     def slo_report(self, invoker_names: Optional[List[str]] = None) -> dict:
         """The `/admin/slo` payload: global + per-namespace + per-invoker
@@ -408,6 +423,50 @@ class TelemetryPlane:
             "buckets_le_ms": self.bounds_ms(),
             "dropped_events": self.dropped_events,
             "global": g,
+            "namespaces": namespaces,
+            "invokers": invokers,
+        }
+
+    def raw_counts(self, invoker_names: Optional[List[str]] = None) -> dict:
+        """The exact-merge export behind `/admin/slo?raw=1` (ISSUE 16):
+        bucket/outcome counts keyed by LABEL, not slot — namespace slot
+        assignment is first-come-first-served per process, so slot-wise
+        merging would pool different tenants. Shares `counts()`'s device
+        sync caveat (SYNCS_DEVICE callers run on a worker thread)."""
+        if not self.enabled:
+            # disabled payload stays byte-identical to pre-federation
+            # builds — the fleet mergers drop disabled members anyway
+            return {"enabled": False}
+        c = self.counts()
+        names = invoker_names or []
+        namespaces = {}
+        for s in range(c["ns_buckets"].shape[0]):
+            if not c["ns_buckets"][s].sum():
+                continue
+            namespaces[self._ns_label(s)] = {
+                "buckets": [int(v) for v in c["ns_buckets"][s]],
+                "outcomes": [int(v) for v in c["ns_outcomes"][s]],
+                "lat_ms": float(c["ns_lat_ms"][s]),
+            }
+        invokers = {}
+        for i in range(c["inv_buckets"].shape[0]):
+            if not c["inv_buckets"][i].sum():
+                continue
+            name = names[i] if i < len(names) else f"invoker{i}"
+            invokers[name] = {
+                "buckets": [int(v) for v in c["inv_buckets"][i]],
+                "outcomes": [int(v) for v in c["inv_outcomes"][i]],
+                "lat_ms": float(c["inv_lat_ms"][i]),
+            }
+        return {
+            "identity": identity(),
+            "enabled": True,
+            "kernel": getattr(self.accumulator, "kernel", "cpu"),
+            "buckets": int(self.accumulator.n_buckets),
+            "targets": {"e2e_p99_ms": self.slo.e2e_p99_ms,
+                        "error_ratio": self.slo.error_ratio},
+            "overrides": dict(self.slo.overrides),
+            "dropped_events": self.dropped_events,
             "namespaces": namespaces,
             "invokers": invokers,
         }

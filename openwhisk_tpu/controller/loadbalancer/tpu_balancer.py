@@ -55,6 +55,7 @@ from ...ops.placement import (PlacementState, RequestBatch, init_state,
 from .journal import decode_array, encode_array
 from ...ops.throttle import init_buckets
 from ...utils.config import load_config
+from ...utils.eventlog import GLOBAL_EVENT_LOG
 from ...utils.ring_buffer import ColumnRing
 from ...messaging.coalesce import export_coalesce_gauges
 from ...messaging.tcp import export_bus_gauges
@@ -1087,6 +1088,9 @@ class TpuBalancer(CommonLoadBalancer):
                 or decision["kernel"] == self.kernel_resolved):
             return
         self.profiler.expect("kernel_swap")
+        GLOBAL_EVENT_LOG.record("kernel_swap",
+                                instance=self.controller.instance,
+                                to=decision["kernel"], why="auto_calibrated")
         sched, release, resolved = decision["pair"]
         self.kernel_resolved = decision["kernel"]
         self.placement_kernel_resolved = resolved
@@ -1144,6 +1148,9 @@ class TpuBalancer(CommonLoadBalancer):
         """Swap the XLA schedule/release kernels in (pallas state outgrew
         the VMEM budget, via growth or snapshot restore)."""
         self.profiler.expect("kernel_swap")
+        GLOBAL_EVENT_LOG.record("kernel_swap",
+                                instance=self.controller.instance,
+                                to="xla", why="vmem_fallback")
         self.kernel_resolved = "xla"
         self._kernel_chosen_by = "fallback"
         self._sched_fn, self._release_fn = self._xla_fns()
@@ -1369,6 +1376,10 @@ class TpuBalancer(CommonLoadBalancer):
                 # evicted the repair kernel's residue scratch: downgrade
                 # to the VMEM scan in place
                 self.profiler.expect("kernel_swap")
+                GLOBAL_EVENT_LOG.record("kernel_swap",
+                                        instance=self.controller.instance,
+                                        to="pallas_scan",
+                                        why="scratch_evicted")
                 (self._sched_fn, self._release_fn,
                  self.placement_kernel_resolved) = _pallas_pair("scan")
                 self._build_packed_fns()
@@ -2318,6 +2329,9 @@ class TpuBalancer(CommonLoadBalancer):
         for pid in pids:
             self.partition_replay[pid] = "replaying"
         from_seq = int((snap_doc or {}).get("journal_seq", 0))
+        GLOBAL_EVENT_LOG.record("absorb_start",
+                                instance=self.controller.instance,
+                                parts=sorted(pids), from_seq=from_seq)
         stats = {"absorbed_partitions": sorted(pids), "replayed": 0}
         try:
             stats = self.replay_journal(journal.records(from_seq),
@@ -2334,6 +2348,11 @@ class TpuBalancer(CommonLoadBalancer):
         finally:
             for pid in pids:
                 self.partition_replay[pid] = "ready"
+        GLOBAL_EVENT_LOG.record("absorb_end",
+                                instance=self.controller.instance,
+                                parts=sorted(pids),
+                                replayed=int(stats.get("replayed", 0)),
+                                skipped=stats.get("skipped"))
         self.metrics.counter("loadbalancer_partitions_absorbed", len(pids))
         return stats
 

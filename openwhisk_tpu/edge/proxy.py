@@ -54,6 +54,10 @@ class Upstream:
     url: str  # e.g. http://127.0.0.1:3233
     fail_until: float = 0.0
     fails: int = 0
+    #: fleet observatory (ISSUE 16): requests sent to / 503s answered by
+    #: this upstream — which controller the edge actually leans on
+    attempts: int = 0
+    http_503: int = 0
 
     def usable(self) -> bool:
         return time.monotonic() >= self.fail_until
@@ -86,6 +90,10 @@ class EdgeProxy:
     _session: Optional[aiohttp.ClientSession] = None
     _runner: Optional[web.AppRunner] = None
     extra_denied_paths: tuple = ("/metrics",)
+    #: bearer token for GET /admin/edge/stats (ISSUE 16). Empty = the
+    #: endpoint always answers 403 — the nginx-era `/metrics { deny
+    #: all; }` posture stays the default, stats are strictly opt-in
+    admin_token: str = ""
 
     @classmethod
     def for_controllers(cls, urls: List[str], **kwargs) -> "EdgeProxy":
@@ -148,8 +156,39 @@ class EdgeProxy:
         # no API path, no vanity host, no gateway route: nothing to serve
         raise web.HTTPNotFound(text="no route")
 
+    # ----------------------------------------------------------- edge stats
+    def _edge_stats(self, request: web.Request) -> web.Response:
+        """`GET /admin/edge/stats`: the edge's in-process counters, shaped
+        so the fleet metrics merger folds the edge in as one more member
+        (`counters` rows are the federation wire format). Bearer-gated on
+        `admin_token`; `/metrics` itself stays denied."""
+        auth = request.headers.get("Authorization", "")
+        token = auth[len("Bearer "):] if auth.startswith("Bearer ") else ""
+        if not self.admin_token or not token or \
+                not secrets.compare_digest(token, self.admin_token):
+            raise web.HTTPForbidden(text="forbidden")
+        from ..utils.eventlog import identity
+        ident = {**identity(), "role": "edge"}
+        counters = [["edge_retry_total", [["reason", reason]], n]
+                    for reason, n in sorted(self.retry_total.items())]
+        for u in self.upstreams:
+            counters.append(["edge_upstream_attempts_total",
+                             [["upstream", u.url]], u.attempts])
+            counters.append(["edge_upstream_http_503_total",
+                             [["upstream", u.url]], u.http_503])
+        return web.json_response({
+            "identity": ident,
+            "counters": counters,
+            "retry_total": dict(self.retry_total),
+            "upstreams": [{"url": u.url, "attempts": u.attempts,
+                           "http_503": u.http_503, "fails": u.fails,
+                           "usable": u.usable()} for u in self.upstreams],
+        })
+
     # ---------------------------------------------------------------- proxy
     async def handle(self, request: web.Request) -> web.Response:
+        if request.path == "/admin/edge/stats" and request.method == "GET":
+            return self._edge_stats(request)
         target = await self._rewrite(request)
         transid = request.headers.get(TRANSACTION_HEADER) or secrets.token_hex(8)
         body = await request.read() if request.can_read_body else None
@@ -174,6 +213,7 @@ class EdgeProxy:
                 # the membership detection window
                 await asyncio.sleep(self._backoff_s(attempt - len(order) + 1))
             upstream = order[attempt % len(order)]
+            upstream.attempts += 1
             try:
                 async with self._session.request(
                         request.method, upstream.url + suffix,
@@ -193,6 +233,7 @@ class EdgeProxy:
                         # `proxy_next_upstream http_503`). No blacklist —
                         # a standby answers everything else fine and
                         # becomes active without re-resolving.
+                        upstream.http_503 += 1
                         last_503 = web.Response(status=503, body=payload,
                                                 headers=out_headers)
                         if attempt + 1 < attempts:

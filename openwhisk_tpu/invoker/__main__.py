@@ -65,12 +65,22 @@ def main() -> None:
                     args.container_factory])
             factory = spi.get("ContainerFactoryProvider").instance(
                 invoker_name=args.unique_name, logger=logger)
+            # fleet observatory (ISSUE 16): announce this invoker's admin
+            # address on its health pings so controllers can build the
+            # peer directory. Gated at WIRING time — disabled keeps the
+            # ping payload byte-exact with pre-observatory builds.
+            from ..utils.eventlog import fleet_config, set_identity
+            fleet_cfg = fleet_config()
+            admin_url = (f"http://127.0.0.1:{args.port}"
+                         if fleet_cfg.enabled and args.port else None)
+            if fleet_cfg.enabled:
+                set_identity(instance=instance_id, role="invoker")
             invoker = InvokerReactive(
                 instance, provider, EntityStore(store),
                 ArtifactActivationStore(store), factory,
                 pool_config=ContainerPoolConfig(user_memory=MB(args.memory),
                                                 pause_grace=1.0),
-                logger=logger)
+                logger=logger, admin_url=admin_url)
             # host hot-loop observatory on the invoker's loop too: the
             # pickup/ack path is half of the per-activation Python the
             # 10k/s arc must attack. Installed BEFORE start() so the

@@ -27,6 +27,7 @@ from ..messaging.connector import (MessageFeed, HEALTH_RETENTION_BYTES,
 from ..messaging.message import (ActivationMessage,
                                  CombinedCompletionAndResultMessage,
                                  CompletionMessage, PingMessage, ResultMessage)
+from ..utils.eventlog import GLOBAL_EVENT_LOG
 from ..utils.scheduler import Scheduler
 from ..utils.transaction import TransactionId
 from ..utils.waterfall import (GLOBAL_WATERFALL, STAGE_INVOKER_PICKUP,
@@ -39,7 +40,8 @@ class InvokerReactive:
                  entity_store: EntityStore, activation_store,
                  container_factory, pool_config: Optional[ContainerPoolConfig] = None,
                  logstore: Optional[ContainerLogStore] = None, logger=None,
-                 metrics=None, ping_interval: float = 1.0):
+                 metrics=None, ping_interval: float = 1.0,
+                 admin_url: Optional[str] = None):
         self.instance = instance
         self.provider = messaging_provider
         self.entity_store = entity_store
@@ -50,6 +52,11 @@ class InvokerReactive:
         self.logger = logger
         self.metrics = metrics
         self.ping_interval = ping_interval
+        #: fleet observatory peer directory (ISSUE 16): when set, every
+        #: health ping announces this invoker's scrapeable admin address.
+        #: None (the default, and whenever the observatory is disabled)
+        #: keeps ping payloads byte-exact with pre-16 builds.
+        self.admin_url = admin_url
         # completion acks, activation events and health pings all ride the
         # coalescing wrapper: under load the ack fan-in ships one frame per
         # micro-batch instead of one bus round trip per completion
@@ -129,7 +136,9 @@ class InvokerReactive:
         await self.blacklist.refresh()
 
     async def _ping(self) -> None:
-        await self.producer.send(HEALTH_TOPIC, PingMessage(self.instance))
+        await self.producer.send(HEALTH_TOPIC,
+                                 PingMessage(self.instance,
+                                             admin=self.admin_url))
 
     async def stop(self) -> None:
         if self._blacklist_poller:
@@ -242,6 +251,10 @@ class InvokerReactive:
                 # its own retry path) owns this work now — running it here
                 # would double-place
                 self.fenced_discards += 1
+                GLOBAL_EVENT_LOG.record(
+                    "fence_discard", instance=self.instance.instance,
+                    role="invoker", part=msg.fence_part,
+                    epoch=msg.fence_epoch, current=current)
                 if self.metrics is not None:
                     self.metrics.counter("invoker_fenced_discards")
                 if self.logger:

@@ -207,17 +207,30 @@ def parse_ack(raw: Union[bytes, str]) -> AcknowledgementMessage:
 
 
 class PingMessage(Message):
-    """Invoker heartbeat on the health topic (Message.scala:124-131)."""
+    """Invoker heartbeat on the health topic (Message.scala:124-131).
 
-    def __init__(self, instance: InvokerInstanceId):
+    `admin` is the fleet observatory's peer-directory announcement
+    (ISSUE 16): the invoker's scrapeable admin address, present only when
+    the observatory is enabled AND an address is configured — None keeps
+    the payload byte-exact with pre-16 pings, and parse tolerates both."""
+
+    def __init__(self, instance: InvokerInstanceId,
+                 admin: Optional[str] = None):
         self.instance = instance
+        self.admin = admin
 
     def to_json(self) -> dict:
-        return {"name": self.instance.to_json()}
+        out = {"name": self.instance.to_json()}
+        if self.admin:
+            out["admin"] = self.admin
+        return out
 
     @classmethod
     def parse(cls, raw) -> "PingMessage":
-        return cls(InvokerInstanceId.from_json(json.loads(raw)["name"]))
+        j = json.loads(raw)
+        admin = j.get("admin")
+        return cls(InvokerInstanceId.from_json(j["name"]),
+                   admin=admin if isinstance(admin, str) and admin else None)
 
 
 class EventMessage(Message):

@@ -66,6 +66,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from .config import load_config
+from .eventlog import identity
 from .ring_buffer import SeqRingBuffer
 from .waterfall import bucket_bounds_ms, bucket_of_us
 
@@ -593,6 +594,8 @@ class HostObservatory:
     def snapshot(self) -> dict:
         """The `GET /admin/profile/host` payload: host-side reads only."""
         if not self.enabled:
+            # disabled payload stays byte-identical to pre-federation
+            # builds — the fleet mergers drop disabled members anyway
             return {"enabled": False}
         with self._lock:
             lag_hist = list(self._lag_hist)
@@ -617,6 +620,9 @@ class HostObservatory:
         ranked = sorted(census.items(), key=lambda kv: -kv[1])
         return {
             "enabled": True,
+            # the federation's merge key (ISSUE 16) — disambiguates
+            # multi-process loadgen's per-worker host snapshots too
+            "identity": identity(),
             "installed": self._installed,
             "uptime_s": round(uptime_s, 3),
             "loop_lag": {
@@ -672,6 +678,38 @@ class HostObservatory:
                         for k, n in ranked[:10]],
             },
         }
+
+    def raw_counts(self) -> dict:
+        """The exact-merge export behind `?raw=1` (ISSUE 16): integer
+        bucket counts / sums only — percentiles do not compose across
+        processes, bucket counts merge bucket-wise bit-exactly."""
+        with self._lock:
+            out = {
+                "identity": identity(),
+                "enabled": self.enabled,
+                "buckets": self.n_buckets,
+                "uptime_s": round(max(0.0, time.monotonic()
+                                      - self._epoch_mono), 3),
+                "lag": {"hist": list(self._lag_hist),
+                        "sum_us": int(self._lag_sum_us),
+                        "max_us": int(self._lag_max_us),
+                        "ticks": int(self._lag_ticks)},
+                "stalls": {"count": int(self._stall_count),
+                           "sum_us": int(self._stall_sum_us)},
+                "gc": {"hist": [list(h) for h in self._gc_hist],
+                       "sum_us": [int(v) for v in self._gc_sum_us],
+                       "count": [int(v) for v in self._gc_count],
+                       "collected": int(self._gc_collected),
+                       "uncollectable": int(self._gc_uncollectable),
+                       "overlapping_dispatch": int(self._gc_in_dispatch)},
+                "tasks": {"created": int(self._tasks_created),
+                          "finished": int(self._tasks_finished)},
+                "serde": [[hop, direction, int(row[0]), int(row[1]),
+                           int(row[2])]
+                          for (hop, direction), row
+                          in sorted(self._serde.items())],
+            }
+        return out
 
     def collapsed_text(self) -> str:
         """The always-on census as flamegraph collapsed-stack lines."""

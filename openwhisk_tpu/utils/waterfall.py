@@ -51,6 +51,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from .config import load_config
+from .eventlog import identity
 from .ring_buffer import SeqRingBuffer
 
 #: the fixed stage enum — index order IS the causal pipeline order
@@ -502,7 +503,7 @@ class ActivationWaterfall:
         }
 
     def _row_json(self, row: dict) -> dict:
-        return {
+        out = {
             "activation_id": row["activation_id"],
             "trace_id": row["trace_id"],
             "ts": row["ts"],
@@ -511,6 +512,13 @@ class ActivationWaterfall:
                           for i, d in enumerate(row["deltas_us"]) if d >= 0},
             "clamped": row.get("clamped", 0),
         }
+        # federation annotations (ISSUE 16): a merged fleet report marks
+        # rows joined across a spill_forward boundary with both halves'
+        # provenance — plain per-process rows never carry these keys
+        for k in ("joined", "origin_instance", "peer_instance", "instance"):
+            if k in row:
+                out[k] = row[k]
+        return out
 
     def slowest(self) -> List[dict]:
         with self._lock:
@@ -526,9 +534,15 @@ class ActivationWaterfall:
         """The `GET /admin/latency/waterfall` payload. Host-side numpy
         only — never a device sync, so it runs inline on the event loop."""
         if not self.enabled:
+            # no identity on the disabled snapshot: the off-switch keeps
+            # the payload byte-identical to pre-federation builds, and the
+            # fleet mergers drop disabled members before keying anyway
             return {"enabled": False}
         out = {
             "enabled": True,
+            # the federation's merge key (ISSUE 16): which process this
+            # snapshot came from
+            "identity": identity(),
             "stages": list(STAGES),
             "finished": self._finished,
             "active": len(self._active),
@@ -541,6 +555,32 @@ class ActivationWaterfall:
         }
         if recent:
             out["recent"] = self.recent(recent)
+        return out
+
+    def raw_counts(self, rows: int = 0) -> dict:
+        """The exact-merge export behind `?raw=1` (ISSUE 16): integer
+        bucket counts and sums, NOT percentiles — percentiles do not
+        compose across processes, bucket counts merge bucket-wise
+        bit-exactly. `rows` > 0 additionally ships the most recent ring
+        rows (raw deltas_us), which the fleet merger needs to join a
+        spilled activation's origin/peer halves by activation id."""
+        with self._lock:
+            out = {
+                "identity": identity(),
+                "enabled": self.enabled,
+                "buckets": self.n_buckets,
+                "stages": list(STAGES),
+                "hist": [list(h) for h in self._hist],
+                "sum_us": list(self._sum_us),
+                "stage_count": list(self._stage_count),
+                "total_hist": list(self._total_hist),
+                "total_sum_us": int(self._total_sum_us),
+                "dominant": list(self._dominant),
+                "dominant_tail": list(self._dominant_tail),
+                "finished": int(self._finished),
+                "rows": ([dict(r) for r in self._ring.last(rows)]
+                         if rows else []),
+            }
         return out
 
     # -- exposition --------------------------------------------------------

@@ -68,6 +68,22 @@ HEADLINES = (
      ("partition_chaos", "double_executions"), "zero"),
     ("partition_chaos_absorbed_rate",
      ("partition_chaos", "absorbed_rate"), "higher"),
+    # ISSUE 16: the reconstructed causal timeline decomposes the chaos
+    # outage into named phases (their sum IS the timeline's downtime, so
+    # a regression here names WHICH phase got slower); plus the --procs
+    # fleet-merged generator headline
+    ("partition_chaos_phase_detect_s",
+     ("partition_chaos", "timeline", "phases", "detect_s"), "lower"),
+    ("partition_chaos_phase_claim_s",
+     ("partition_chaos", "timeline", "phases", "claim_s"), "lower"),
+    ("partition_chaos_phase_absorb_s",
+     ("partition_chaos", "timeline", "phases", "absorb_s"), "lower"),
+    ("partition_chaos_phase_first_placement_s",
+     ("partition_chaos", "timeline", "phases", "first_placement_s"),
+     "lower"),
+    ("fleet_merged_sustained_per_sec",
+     ("e2e_open_loop", "multiproc_point", "fleet_merged_sustained_per_sec"),
+     "higher"),
 )
 
 

@@ -373,7 +373,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                    fixed_rate: Optional[float] = None, seed: int = 1,
                    host_observatory: Optional[bool] = None,
                    gc_tune: bool = True, fleet_mesh: bool = False,
-                   keep_samples: bool = False) -> dict:
+                   keep_samples: bool = False,
+                   worker_ident: Optional[int] = None) -> dict:
     """The observatory: sweep offered rate (doubling from `rate0`) to the
     max sustainable throughput, then re-measure that rate for the headline
     row + the waterfall's per-stage budget. `fixed_rate` skips the sweep
@@ -398,6 +399,11 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
     async def go() -> dict:
         from openwhisk_tpu.utils.hostprof import GLOBAL_HOST_OBSERVATORY
         from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL
+        if worker_ident is not None:
+            # --procs worker: stamp the fleet-observatory identity block so
+            # the parent's merged snapshot carries per-member provenance
+            from openwhisk_tpu.utils.eventlog import set_identity
+            set_identity(instance=worker_ident, role="loadgen")
         obs_installed = False
         if host_observatory is not None:
             GLOBAL_HOST_OBSERVATORY.enabled = bool(host_observatory)
@@ -557,6 +563,12 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                     budget["p50_decomposition_sum_ms"] / head["p50_ms"], 3)
             host = (GLOBAL_HOST_OBSERVATORY.snapshot() if obs_installed
                     else None)
+            # exact-merge export for the --procs parent (ISSUE 16): raw
+            # integer bucket counts merge bucket-wise bit-exactly; the
+            # rendered snapshot's percentiles do not compose
+            host_raw = (GLOBAL_HOST_OBSERVATORY.raw_counts()
+                        if obs_installed and worker_ident is not None
+                        else None)
             return {
                 "mode": "open_loop",
                 "dist": dist,
@@ -576,6 +588,7 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                 "stage_budget": budget,
                 "tail_attribution": tail,
                 "host": host,
+                "host_raw": host_raw,
                 "n_invokers": n_invokers,
             }
         finally:
@@ -633,7 +646,10 @@ def multiproc_fixed_rate(rate: float, procs: int, duration: float = 2.5,
         if not waterfall:
             cmd.append("--no-waterfall")
         if host_observatory:
-            cmd.append("--host-observatory")
+            # each worker stamps its fleet identity and emits raw integer
+            # bucket counts; the parent merges them into ONE fleet
+            # snapshot (ISSUE 16) instead of N per-worker blobs
+            cmd += ["--host-observatory", "--worker-ident", str(i)]
         workers.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                         stderr=subprocess.PIPE,
                                         text=True))
@@ -711,15 +727,25 @@ def multiproc_fixed_rate(rate: float, procs: int, duration: float = 2.5,
             "max_fire_lag_ms": gen.get("max_fire_lag_ms"),
             "gc_pauses": gen.get("gc_pauses"),
         }
-        if host_observatory and r.get("host") is not None:
-            # per-worker twin snapshot: quantiles don't compose across
-            # processes, so the snapshots stay per-worker rather than
-            # pretending to merge
-            row["host"] = r.get("host")
         per_worker.append(row)
+    # ONE fleet-merged host snapshot (ISSUE 16): the workers export raw
+    # integer bucket counts (host_raw), which merge bucket-wise
+    # bit-exactly — the federation's merge math, reused verbatim —
+    # replacing the N per-worker blobs this mode used to emit
+    host_fleet = None
+    if host_observatory:
+        host_raws = [r.get("host_raw") for r in ok_rows
+                     if r.get("host_raw")]
+        if host_raws:
+            from openwhisk_tpu.controller.monitoring import \
+                merged_host_report
+            host_fleet = merged_host_report(host_raws)
     merged_p99 = pctl(0.99)
     all_sustained = (len(ok_rows) == procs
                      and all(r.get("sustained") for r in ok_rows))
+    fleet_sustained_per_sec = round(
+        sum(w.get("throughput_per_sec") or 0.0
+            for w in per_worker if "error" not in w), 1)
     return {
         "mode": "open_loop_multiproc",
         "procs": procs,
@@ -732,15 +758,15 @@ def multiproc_fixed_rate(rate: float, procs: int, duration: float = 2.5,
         "sustained": bool(all_sustained
                           and merged_p99 is not None
                           and merged_p99 <= p99_bound_ms),
-        "sustained_activations_per_sec": round(
-            sum(w.get("throughput_per_sec") or 0.0
-                for w in per_worker if "error" not in w), 1),
+        "sustained_activations_per_sec": fleet_sustained_per_sec,
+        "fleet_merged_sustained_per_sec": fleet_sustained_per_sec,
         "completed": len(samples),
         "p50_ms": pctl(0.50),
         "p90_ms": pctl(0.90),
         "p99_ms": merged_p99,
         "p99_bound_ms": p99_bound_ms,
         "latency_base": "scheduled_arrival",
+        "host_fleet": host_fleet,
         "per_worker": per_worker,
     }
 
@@ -778,6 +804,10 @@ def main() -> None:
     ap.add_argument("--emit-samples", action="store_true",
                     help="keep the headline run's raw latency samples in "
                          "the JSON line (the --procs parent merges them)")
+    ap.add_argument("--worker-ident", type=int, default=None,
+                    help="(set by the --procs parent) this worker's fleet "
+                         "identity instance; stamps identity blocks and "
+                         "emits host_raw for the parent's exact merge")
     ap.add_argument("--fleet-mesh", action="store_true",
                     help="run the target balancer in fleet-mesh mode "
                          "(CONFIG_whisk_loadBalancer_fleetMesh semantics; "
@@ -809,7 +839,8 @@ def main() -> None:
                                                    else None),
                                  gc_tune=not args.no_gc_tune,
                                  fleet_mesh=args.fleet_mesh,
-                                 keep_samples=args.emit_samples)
+                                 keep_samples=args.emit_samples,
+                                 worker_ident=args.worker_ident)
     except Exception as e:  # noqa: BLE001 — one parseable line, always
         import traceback
         traceback.print_exc(file=sys.stderr)
