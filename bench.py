@@ -212,12 +212,17 @@ def _bench_action(name, memory=256):
     return a
 
 
-async def _echo_invoker(provider, instance):
+async def _echo_invoker(provider, instance, delay=0.0):
     """An invoker stand-in: consumes its topic, acks every activation
     immediately with a successful record (pure control-plane load). Rides
     the same batch wire as the real InvokerReactive: a columnar dispatch
     frame decodes ONCE, and the whole frame's acks are submitted in one
-    sweep so they coalesce into one ack batch frame back."""
+    sweep so they coalesce into one ack batch frame back.
+
+    `delay` rides as a mutable attribute on the returned feed (the PR 4
+    SimInvoker idiom, so tools/loadgen.py's `apply_stragglers` drives
+    test stubs and bench feeds through the same knob): a straggler's
+    acks sleep `feed.delay` seconds before flushing."""
     from openwhisk_tpu.core.entity import (ActivationResponse, EntityPath,
                                            WhiskActivation)
     from openwhisk_tpu.messaging import (ActivationMessage,
@@ -253,6 +258,11 @@ async def _echo_invoker(provider, instance):
                 f"completed{msg.root_controller_index.as_string}",
                 []).append(CombinedCompletionAndResultMessage(
                     msg.transid, act, instance))
+        # straggler injection: read the live knob each frame (riders and
+        # tests retune it mid-run, like the PR 4 SimInvoker scenario)
+        d = getattr(box["feed"], "delay", 0.0)
+        if d:
+            await asyncio.sleep(d)
         # send_batch: every ack submits in THIS sweep (one dispatch
         # frame's acks flush as one ack batch frame) with no task per
         # message — asyncio.gather over N send() coroutines minted a
@@ -262,25 +272,31 @@ async def _echo_invoker(provider, instance):
         box["feed"].processed()
 
     feed = MessageFeed(topic, consumer, 256, handle)
+    feed.delay = delay
     box["feed"] = feed
     feed.start()
     return feed
 
 
-async def _echo_fleet(provider, n_invokers):
+async def _echo_fleet(provider, n_invokers, stragglers=None):
     """Start `n_invokers` echo invokers + a 1 Hz pinger (supervision marks a
     fleet Offline after 10 s of silence, which a cold first compile easily
-    outlasts). Returns (feeds, stop) — await stop() to end the pinger."""
+    outlasts). Returns (feeds, stop) — await stop() to end the pinger.
+    `stragglers`: a {index: delay_s} map (or the loadgen SPEC string) —
+    those invokers' acks are delayed from the first frame."""
     from openwhisk_tpu.core.entity import MB, InvokerInstanceId
     from openwhisk_tpu.messaging import PingMessage
+    from tools.loadgen import parse_stragglers
 
+    slow = parse_stragglers(stragglers)
     producer = provider.get_producer()
     provider.ensure_topic("health")
     feeds, instances = [], []
     for i in range(n_invokers):
         inst = InvokerInstanceId(i, user_memory=MB(8192))
         instances.append(inst)
-        feeds.append(await _echo_invoker(provider, inst))
+        feeds.append(await _echo_invoker(provider, inst,
+                                         delay=slow.get(i, 0.0)))
         await producer.send("health", PingMessage(inst))
     stop_ping = asyncio.Event()
 
@@ -826,6 +842,229 @@ def _fleet_observatory_overhead(repeats: int = 20, total: int = 1000,
         if _backend_unavailable(e):
             raise  # the fallback runner re-runs this rider on CPU
         print(f"# fleet_observatory_overhead failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _placement_quality(total: int = 400, concurrency: int = 32,
+                       n_invokers: int = 8,
+                       stragglers: str = "3:0.25") -> Optional[dict]:
+    """ISSUE 17 A/B: the placement-quality plane under a straggler.
+
+    Two arms over the same workload shape, fresh fixture each (EWMAs
+    must not leak between arms): `straggler` injects ack delay on one
+    invoker via the shared PR 4 helper (tools/loadgen.apply_stragglers),
+    so the anomaly plane flags it and the shadow counterfactual runs the
+    penalty-demoted probe geometry; `clean` runs the identical drive
+    with no injection, where the penalty vector stays zero and the
+    shadow MUST be bit-identical to production (divergent_rows == 0 is
+    the end-to-end restatement of the parity property the tier-1 fuzz
+    asserts). The pair is the plane's payoff evidence: regret +
+    divergence with the shadow penalty effectively on vs off."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+    from openwhisk_tpu.controller.loadbalancer.quality import (QualityConfig,
+                                                               QualityPlane)
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.transaction import TransactionId
+    from tools.loadgen import apply_stragglers
+
+    async def arm(spec) -> dict:
+        provider = MemoryMessagingProvider()
+        qp = QualityPlane(QualityConfig(enabled=True, shadow_every_n=4))
+        # prewarm off: background compiles are pure GIL contention inside
+        # the measured window (the PR-5 lesson, same as the anomaly e2e)
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          kernel="xla", quality=qp, prewarm=False)
+        await bal.start()
+        feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= n_invokers:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("placement quality rider: fleet unhealthy")
+        applied = apply_stragglers(feeds, spec)
+
+        actions = [_bench_action(f"pq{i}", memory=128) for i in range(8)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            action = actions[i % len(actions)]
+            msg = ActivationMessage(
+                TransactionId(), action.fully_qualified_name, action.rev.rev,
+                ident, ActivationId.generate(), ControllerInstanceId("0"),
+                True, {})
+            async with sem:
+                promise = await bal.publish(action, msg)
+                await promise
+
+        try:
+            # warmup compiles (production + shadow + scorer shapes)
+            await asyncio.gather(*[one(i) for i in range(min(64, total))])
+            # drive in rounds with supervision ticks between them: the
+            # anomaly detector harvests one tick late, and the straggler
+            # flags become the shadow penalty only on the NEXT refresh
+            rounds = 5
+            per = max(1, total // rounds)
+            for _ in range(rounds):
+                await asyncio.gather(*[one(i) for i in range(per)])
+                bal._telemetry_tick()
+                await asyncio.sleep(0.1)
+            # two settle ticks + one more driven round so shadow batches
+            # actually run WITH the refreshed penalty in effect
+            for _ in range(2):
+                bal._telemetry_tick()
+                await asyncio.sleep(0.1)
+            await asyncio.gather(*[one(i) for i in range(per)])
+            report = await asyncio.to_thread(
+                qp.quality_report, bal._telemetry_invoker_names())
+        finally:
+            await stop_fleet()
+            await bal.close()
+            for f in feeds:
+                await f.stop()
+        return {
+            "stragglers": {str(k): v for k, v in applied.items()},
+            "penalized_invokers": int((bal._shadow_penalty_np > 0).sum()),
+            "regret_sum_ms": report.get("regret_sum_ms"),
+            "regret_p99_le_ms": report.get("regret_p99_le_ms"),
+            "fleet_imbalance_cov": report.get("fleet_imbalance_cov"),
+            "shadow_batches": report.get("shadow_batches"),
+            "shadow_rows": report.get("shadow_rows"),
+            "divergent_rows": report.get("divergent_rows"),
+            "divergence_ratio": report.get("divergence_ratio"),
+            "counters": report.get("counters"),
+            "per_invoker": report.get("invokers"),
+        }
+
+    try:
+        with_straggler = asyncio.run(arm(stragglers))
+        clean = asyncio.run(arm(None))
+        return {
+            "straggler": with_straggler,
+            "clean": clean,
+            # the pair's headline: how differently the penalized geometry
+            # places under a real straggler vs the zero-penalty identity
+            "shadow_divergence_ratio": with_straggler["divergence_ratio"],
+            "clean_divergent_rows": clean["divergent_rows"],
+        }
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# placement_quality failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _placement_quality_overhead(repeats: int = 20, total: int = 1000,
+                                concurrency: int = 64) -> Optional[dict]:
+    """ISSUE 17 gate (<= 5%): the quality plane's marginal cost through
+    the full balancer path — the per-batch scorer dispatch plus one
+    shadow pass every N batches. Same paired-segment protocol as
+    `_fleet_observatory_overhead` (fixture ONCE, armed/disarmed segments
+    back-to-back, order flipped per repeat, 20%-trimmed mean of paired
+    ratios): the effect is small and between-run host jitter is 4x, so
+    only a paired design measures it. The disarmed half parks the shadow
+    fn and flips `enabled`, which is exactly what the off-switch does on
+    the dispatch path — production decisions are bit-exact either way
+    (tier-1-asserted), so the pair measures pure observability tax."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+    from openwhisk_tpu.controller.loadbalancer.quality import (QualityConfig,
+                                                               QualityPlane)
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.transaction import TransactionId
+
+    async def go() -> dict:
+        provider = MemoryMessagingProvider()
+        qp = QualityPlane(QualityConfig(enabled=True, shadow_every_n=16))
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          kernel="xla", quality=qp)
+        await bal.start()
+        feeds, stop_fleet = await _echo_fleet(provider, 16)
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= 16:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("placement quality overhead: fleet unhealthy")
+
+        actions = [_bench_action(f"pqo{i}", memory=128) for i in range(8)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            action = actions[i % len(actions)]
+            msg = ActivationMessage(
+                TransactionId(), action.fully_qualified_name, action.rev.rev,
+                ident, ActivationId.generate(), ControllerInstanceId("0"),
+                True, {})
+            async with sem:
+                promise = await bal.publish(action, msg)
+                await promise
+
+        async def segment() -> float:
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one(i) for i in range(total)])
+            return total / (time.perf_counter() - t0)
+
+        shadow_fn = bal._shadow_fn
+
+        def set_armed(armed: bool) -> None:
+            # the off-switch's dispatch-path effect, minus a rebuild:
+            # enabled=False skips the scorer, a parked shadow fn skips
+            # the counterfactual
+            qp.enabled = armed
+            bal._shadow_fn = shadow_fn if armed else None
+
+        try:
+            await segment()  # warmup: production + shadow + scorer compiles
+            pairs = []
+            on_rates, off_rates = [], []
+            for k in range(repeats):
+                order = (True, False) if k % 2 == 0 else (False, True)
+                rate = {}
+                for armed in order:
+                    set_armed(armed)
+                    rate[armed] = await segment()
+                set_armed(True)
+                on_rates.append(rate[True])
+                off_rates.append(rate[False])
+                pairs.append(100.0 * (rate[False] - rate[True])
+                             / rate[False])
+        finally:
+            await stop_fleet()
+            await bal.close()
+            for f in feeds:
+                await f.stop()
+        trim = max(1, len(pairs) // 5)
+        kept = sorted(pairs)[trim:-trim] if len(pairs) > 2 * trim else pairs
+        return {
+            "rate_placement_quality_on": round(max(on_rates), 1),
+            "rate_placement_quality_off": round(max(off_rates), 1),
+            "overhead_pct": round(statistics.mean(kept), 2),
+            "pair_overheads_pct": [round(p, 2) for p in pairs],
+            "repeats": repeats,
+            "shadow_every_n": qp.shadow_every_n,
+            "agg": "trimmed_mean_paired_segments",
+        }
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# placement_quality_overhead failed: {e!r}", file=sys.stderr)
         return None
 
 
@@ -2551,6 +2790,8 @@ def _run(args) -> Optional[dict]:
     anomaly_overhead = None
     waterfall_overhead = None
     fleet_observatory_overhead = None
+    placement_quality = None
+    placement_quality_overhead = None
     e2e_open_loop = None
     repair_vs_scan = None
     pipeline_speedup = None
@@ -2583,6 +2824,13 @@ def _run(args) -> Optional[dict]:
         # federation, so steady state should measure ~0)
         fleet_observatory_overhead = timed_rider(
             "_fleet_observatory_overhead", _fleet_observatory_overhead)
+        # ISSUE 17: the placement quality plane — straggler A/B payoff
+        # (regret + shadow divergence with the penalty on vs off) and its
+        # <= 5% paired-overhead gate
+        placement_quality = timed_rider("_placement_quality",
+                                        _placement_quality)
+        placement_quality_overhead = timed_rider(
+            "_placement_quality_overhead", _placement_quality_overhead)
         repair_vs_scan = timed_rider("_repair_vs_scan", _repair_vs_scan)
         # ROADMAP item 2: placement rate per fleet size over the
         # ('fleet',) mesh (the MULTICHIP dryrun folded into the bench)
@@ -2702,6 +2950,10 @@ def _run(args) -> Optional[dict]:
         out["waterfall_overhead"] = waterfall_overhead
     if fleet_observatory_overhead is not None:
         out["fleet_observatory_overhead"] = fleet_observatory_overhead
+    if placement_quality is not None:
+        out["placement_quality"] = placement_quality
+    if placement_quality_overhead is not None:
+        out["placement_quality_overhead"] = placement_quality_overhead
     if host_profiling_overhead is not None:
         out["host_profiling_overhead"] = host_profiling_overhead
     if host_observatory is not None:

@@ -117,6 +117,11 @@ class ControllerApi:
         r.add_get("/admin/placement/explain/{activation_id}",
                   self.placement_explain)
         r.add_get("/admin/placement/occupancy", self.placement_occupancy)
+        # placement quality observatory (ISSUE 17): on-device regret /
+        # imbalance scoring plus the shadow-counterfactual diff, and its
+        # fleet-federated fold. 404 while
+        # CONFIG_whisk_placementQuality_enabled=false (true no-op).
+        r.add_get("/admin/placement/quality", self.placement_quality)
         # SLO plane: compliance / budget / burn rates from the balancer's
         # telemetry accumulator, auth-gated like the placement endpoints
         r.add_get("/admin/slo", self.slo_report)
@@ -151,6 +156,7 @@ class ControllerApi:
         r.add_get("/admin/fleet/waterfall", self.fleet_waterfall)
         r.add_get("/admin/fleet/slo", self.fleet_slo)
         r.add_get("/admin/fleet/host", self.fleet_host)
+        r.add_get("/admin/fleet/quality", self.fleet_quality)
         r.add_get("/admin/fleet/timeline", self.fleet_timeline)
         return app
 
@@ -437,6 +443,36 @@ class ControllerApi:
         if tp.SYNCS_DEVICE:
             # reading device counts forces a device sync — worker thread,
             # same policy as the occupancy endpoint
+            report = await asyncio.to_thread(fn, names)
+        else:
+            report = fn(names)
+        return web.json_response(report)
+
+    async def placement_quality(self, request):
+        """How good are the placement kernel's decisions: per-row regret
+        (chosen invoker's predicted latency vs the best feasible
+        alternative under the same capacity/permit constraints), fleet
+        occupancy imbalance, forced/overflow/cold-start attribution, and
+        the shadow counterfactual diff against the anomaly-penalized
+        probe geometry. 404 while the plane is disabled — disabled is a
+        true no-op, there is nothing to report."""
+        qp = getattr(self.c.load_balancer, "quality", None)
+        if qp is None or not qp.enabled:
+            return _error(
+                404, "the placement quality plane is disabled "
+                "(CONFIG_whisk_placementQuality_enabled=false)",
+                request.get("transid"))
+        names = []
+        lb = self.c.load_balancer
+        if hasattr(lb, "_telemetry_invoker_names"):
+            names = lb._telemetry_invoker_names()
+        # ?raw=1: the exact-merge export the fleet federation scrapes
+        # (integer bucket counts + label-keyed per-invoker series)
+        raw = request.query.get("raw", "").lower() in ("1", "true", "yes")
+        fn = qp.raw_counts if raw else qp.quality_report
+        if qp.SYNCS_DEVICE:
+            # reading the device QualityState forces a device sync —
+            # worker thread, same policy as /admin/slo
             report = await asyncio.to_thread(fn, names)
         else:
             report = fn(names)
@@ -801,6 +837,35 @@ class ControllerApi:
             request, cfg, "/admin/profile/host?raw=1")
         raws += [peers[k] for k in sorted(peers)]
         body = merged_host_report(raws)
+        body["members_missing"] = missing
+        return web.json_response(body)
+
+    async def fleet_quality(self, request):
+        """Fleet-merged placement quality: regret histograms and
+        attribution counters sum positionally bit-exactly, per-invoker
+        divergence series merge by label, then the fleet regret p99
+        re-derives from the MERGED histogram — counts, not an average of
+        per-member p99s. Imbalance stays per-member (it is a shape
+        statistic over each member's own partition)."""
+        cfg = self._fleet_cfg()
+        if cfg is None:
+            return self._fleet_disabled(request)
+        from .monitoring import merged_quality_report
+        raws = []
+        lb = self.c.load_balancer
+        qp = getattr(lb, "quality", None)
+        if qp is not None and qp.enabled:
+            names = []
+            if hasattr(lb, "_telemetry_invoker_names"):
+                names = lb._telemetry_invoker_names()
+            if qp.SYNCS_DEVICE:
+                raws.append(await asyncio.to_thread(qp.raw_counts, names))
+            else:
+                raws.append(qp.raw_counts(names))
+        peers, missing = await self._fleet_scrape(
+            request, cfg, "/admin/placement/quality?raw=1")
+        raws += [peers[k] for k in sorted(peers)]
+        body = merged_quality_report(raws)
         body["members_missing"] = missing
         return web.json_response(body)
 

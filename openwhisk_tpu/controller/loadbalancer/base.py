@@ -39,6 +39,7 @@ from ...ops.profiler import KernelProfiler
 from ...ops.telemetry import (OUTCOME_ERROR, OUTCOME_SUCCESS, OUTCOME_TIMEOUT)
 from .anomaly import AnomalyPlane
 from .flight_recorder import BatchRecord, FlightRecorder
+from .quality import QualityPlane
 from .telemetry import TelemetryPlane
 
 # invoker states (ref InvokerState in InvokerSupervision.scala)
@@ -195,7 +196,8 @@ class CommonLoadBalancer(LoadBalancer):
                  telemetry: Optional[TelemetryPlane] = None,
                  profiler: Optional[KernelProfiler] = None,
                  anomaly: Optional[AnomalyPlane] = None,
-                 waterfall: Optional[ActivationWaterfall] = None):
+                 waterfall: Optional[ActivationWaterfall] = None,
+                 quality: Optional[QualityPlane] = None):
         self.provider = messaging_provider
         self.controller = controller_instance
         self.logger = logger
@@ -285,6 +287,17 @@ class CommonLoadBalancer(LoadBalancer):
                           else GLOBAL_WATERFALL)
         self._waterfall_renderer = self._waterfall_exposition
         self.metrics.register_renderer(self._waterfall_renderer)
+        # the placement-quality plane (same hook pattern, default OFF):
+        # per-batch regret/imbalance scoring on device for the TPU
+        # balancer, attribution counters off record_placement for the CPU
+        # balancers, plus the shadow counterfactual diff — the measured
+        # A/B that gates ROADMAP item 4's placement feedback
+        self.quality = (quality if quality is not None
+                        else QualityPlane.from_config())
+        self.quality.attach(anomaly=self.anomaly,
+                            invoker_names=self._telemetry_invoker_names)
+        self._quality_renderer = self._quality_exposition
+        self.metrics.register_renderer(self._quality_renderer)
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -749,6 +762,11 @@ class CommonLoadBalancer(LoadBalancer):
         """Record one placement decision as a one-row batch record (the TPU
         balancer records whole micro-batches itself). CPU balancers carry a
         `kernel: "cpu"` digest; callers may add backend detail."""
+        # quality plane attribution (CPU balancers; the TPU balancer
+        # scores whole micro-batches on device instead) — independent of
+        # the flight recorder's own off-switch
+        self.quality.observe_decision(chosen >= 0, bool(forced),
+                                      bool(throttled))
         fr = self.flight_recorder
         if not fr.enabled:
             return
@@ -809,6 +827,10 @@ class CommonLoadBalancer(LoadBalancer):
     def _waterfall_exposition(self, openmetrics: bool = False) -> str:
         return self.waterfall.prometheus_text(openmetrics=openmetrics)
 
+    def _quality_exposition(self, openmetrics: bool = False) -> str:
+        return self.quality.prometheus_text(
+            self._telemetry_invoker_names(), openmetrics=openmetrics)
+
     # -- kernel profiling plane (shared hook, like the flight recorder) ----
     def kernel_profile(self) -> dict:
         """The `GET /admin/profile/kernel` payload. CPU balancers report a
@@ -841,6 +863,7 @@ class CommonLoadBalancer(LoadBalancer):
         self.metrics.unregister_renderer(self._profiler_renderer)
         self.metrics.unregister_renderer(self._anomaly_renderer)
         self.metrics.unregister_renderer(self._waterfall_renderer)
+        self.metrics.unregister_renderer(self._quality_renderer)
 
 
 def _bridge_publish_future(row: asyncio.Future, waiter: asyncio.Future) -> None:

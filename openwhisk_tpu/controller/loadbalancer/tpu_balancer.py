@@ -46,9 +46,14 @@ from ...core.entity import ExecutableWhiskAction, InvokerInstanceId
 from ...messaging.message import ActivationMessage
 from ...models.sharding_policy import (MIN_SLOT_MB, generate_hash,
                                        pairwise_coprimes)
+from ...ops.anomaly import S_EWMA_MS, S_STRAGGLER_FLAG
+from ...ops.decision_quality import (S_DIVERGENT, S_IMBALANCE_COV,
+                                     S_REGRET_SUM_MS, init_quality_state)
 from ...ops.placement import (PlacementState, RequestBatch, init_state,
                               make_fused_admit_step_packed,
                               make_fused_step_packed, make_release_packed,
+                              make_shadow_admit_step_packed,
+                              make_shadow_step_packed,
                               release_batch, release_batch_vector,
                               schedule_batch, schedule_batch_repair,
                               set_health, unpack_chosen, unpack_step_output)
@@ -540,10 +545,10 @@ class TpuBalancer(CommonLoadBalancer):
                  fleet_mesh: Optional[bool] = None,
                  fleet_shards: Optional[int] = None,
                  batch_publish: Optional[bool] = None,
-                 profiler=None, anomaly=None, waterfall=None):
+                 profiler=None, anomaly=None, waterfall=None, quality=None):
         super().__init__(messaging_provider, controller_instance, logger,
                          metrics, profiler=profiler, anomaly=anomaly,
-                         waterfall=waterfall)
+                         waterfall=waterfall, quality=quality)
         self._cluster_size = cluster_size
         path_cfg = load_config(PlacementPathConfig, env_path="load_balancer")
         #: "auto" | "xla" | "pallas" (single-device backend knob)
@@ -681,6 +686,19 @@ class TpuBalancer(CommonLoadBalancer):
         self._books_cache: Optional[np.ndarray] = None
         self._books_seq = 0
         self._books_cache_seq = 0
+        #: placement-quality plane inputs, host-refreshed on the 1 Hz
+        #: supervision tick from the anomaly plane's harvested scores:
+        #: padded per-invoker cost (latency EWMA) and capacity vectors for
+        #: the scorer, and the straggler-flag penalty for the shadow
+        #: kernel (uploaded to device lazily, only when the flags change)
+        self._quality_ewma_np = np.zeros(self._n_pad, np.float32)
+        self._quality_caps_np = np.zeros(self._n_pad, np.int32)
+        self._quality_ewma = None
+        self._quality_caps = None
+        self._shadow_penalty_np = np.zeros(self._n_pad, np.int32)
+        self._shadow_penalty = None
+        self._shadow_fn = None
+        self._quality_batches = 0
         self._init_device_state()
 
         # pending request queue + delta buffers; with ring_assembly the int
@@ -737,6 +755,12 @@ class TpuBalancer(CommonLoadBalancer):
         # dispatches now and its scores harvest NEXT tick (no device sync
         # on the event loop, same rule as the burn-rate math)
         self.anomaly.tick(self.metrics)
+        # the quality plane rides the same cadence: refresh its cost/
+        # penalty vectors from the scores the anomaly tick just harvested,
+        # then its gauges (host aggregates only — no device sync)
+        if self.quality.enabled:
+            self._refresh_quality_signals()
+            self.quality.tick(self.metrics)
         # HBM watermark gauges ride the same 1 Hz tick (guarded no-op on
         # backends without memory_stats, e.g. CPU)
         self.profiler.refresh_memory(self.metrics)
@@ -816,6 +840,15 @@ class TpuBalancer(CommonLoadBalancer):
         self._build_packed_fns()
         self._export_kernel_gauge()
         self._set_books_now(np.asarray(self.state.free_mb))
+        # placement-quality plane: device accumulator + jitted scorer keyed
+        # to the current invoker pad (a geometry rebuild restarts the
+        # accumulated quality counts — different arrays, like the anomaly
+        # plane's kernel swaps). Live state keeps conc in [N, A] on every
+        # backend (the pallas pair transposes inside its own program), so
+        # the scorer never needs the transposed layout here.
+        if self.quality.enabled:
+            self.quality.use_device(self._n_pad)
+            self._refresh_quality_signals()
 
     #: class aliases of the module constants (tests and subclasses key off
     #: these; the schedule-pair builders live at module level so the
@@ -887,6 +920,84 @@ class TpuBalancer(CommonLoadBalancer):
         self._warm_sigs = set()
         self._warm_queue = []
         self._warm_task = getattr(self, "_warm_task", None)
+        self._build_shadow_fn()
+
+    def _build_shadow_fn(self) -> None:
+        """(Re)build the decision-only shadow twin for the resolved
+        backend (quality plane). The twin runs the penalty-augmented
+        variant of the PRODUCTION kernel family over the same packed
+        buffer and release/health folds, so divergence measures the
+        penalty, not a kernel swap; it never donates and writes nothing
+        back — production stays bit-exact with the plane on."""
+        self._shadow_fn = None
+        if not (self.quality.enabled and self.quality.shadow_every_n > 0):
+            return
+        if self.mesh is not None:
+            # every schedule pair is bit-exact with every other, so the
+            # mesh shadow always runs the penalized sharded repair kernel
+            # regardless of which pair fleet_pair resolved for production
+            from ...parallel.fleet_mesh import make_fleet_repair_schedule
+            sched = make_fleet_repair_schedule(self.mesh,
+                                               axis=self.fleet_axis,
+                                               penalized=True)
+        elif self.kernel_resolved == "pallas":
+            from ...ops.placement_pallas import (
+                schedule_batch_pallas, schedule_batch_repair_pallas,
+                to_transposed)
+            interpret = jax.default_backend() == "cpu"
+            repair = self.placement_kernel_resolved == "repair"
+
+            def sched(st, batch, penalty, _repair=repair):
+                # the transposed result state is dead in the shadow
+                # program (decisions only) — XLA drops the transposes
+                fn = (schedule_batch_repair_pallas if _repair
+                      else schedule_batch_pallas)
+                return fn(to_transposed(st), batch, interpret=interpret,
+                          penalty=penalty)
+        elif self.placement_kernel_resolved == "repair":
+            sched = schedule_batch_repair
+        else:
+            sched = schedule_batch
+        if self.rate_limit_per_minute is not None:
+            self._shadow_fn = make_shadow_admit_step_packed(
+                self._release_fn, sched)
+        else:
+            self._shadow_fn = make_shadow_step_packed(self._release_fn,
+                                                      sched)
+
+    def _refresh_quality_signals(self) -> None:
+        """Host-side refresh of the quality-plane input vectors (1 Hz
+        supervision tick + geometry rebuilds): the anomaly plane's
+        latency EWMAs become the scorer's cost vector, its straggler
+        flags the shadow penalty. All three vectors re-upload to device
+        only when they actually change — the scorer runs every batch, so
+        a per-batch host->device transfer of 1 Hz signals would tax the
+        dispatch path for nothing; steady fleets pay nothing."""
+        n = self._n_pad
+        caps = np.zeros(n, np.int32)
+        reg_caps = getattr(self, "_caps_mb", None)
+        if reg_caps is not None:
+            m = min(n, len(reg_caps))
+            caps[:m] = np.minimum(reg_caps[:m], 2 ** 31 - 1)
+        if (self._quality_caps is None
+                or not np.array_equal(caps, self._quality_caps_np)):
+            self._quality_caps_np = caps
+            self._quality_caps = jnp.asarray(caps)
+        ewma = np.zeros(n, np.float32)
+        pen = np.zeros(n, np.int32)
+        sc = getattr(self.anomaly, "_scores", None)
+        if sc is not None:
+            k = min(n, sc.shape[1])
+            ewma[:k] = sc[S_EWMA_MS, :k]
+            pen[:k] = sc[S_STRAGGLER_FLAG, :k].astype(np.int32)
+        if (self._quality_ewma is None
+                or not np.array_equal(ewma, self._quality_ewma_np)):
+            self._quality_ewma_np = ewma
+            self._quality_ewma = jnp.asarray(ewma)
+        if (self._shadow_penalty is None
+                or not np.array_equal(pen, self._shadow_penalty_np)):
+            self._shadow_penalty_np = pen
+            self._shadow_penalty = jnp.asarray(pen)
 
     def _prewarm_buckets(self, r: int, h: int, b: int) -> None:
         """Compile-ahead for the packed step's SUCCESSOR bucket shapes. A
@@ -963,17 +1074,43 @@ class TpuBalancer(CommonLoadBalancer):
                 st = shard_state(st, self.mesh, axis=self.fleet_axis)
             return st
 
+        buckets = None
         if rate_on:
             buckets = init_buckets(self.RATE_NS_BUCKETS,
                                    self.rate_limit_per_minute)
-            fn((dummy_state(), buckets), buf,
-               np.float32(time.monotonic() - self._t0_mono), wr, wh, wb)
+            (st_w, _bk), out_w = fn(
+                (dummy_state(), buckets), buf,
+                np.float32(time.monotonic() - self._t0_mono), wr, wh, wb)
         else:
-            fn(dummy_state(), buf, wr, wh, wb)
+            st_w, out_w = fn(dummy_state(), buf, wr, wh, wb)
         # the idle release fold compiles its own release-only program
         # per R bucket — warm it too, or a drain-only lull still eats
         # the in-dispatch compile stall this plane exists to avoid
         release_packed_fn(dummy_state(), np.zeros((5, wr), np.int32))
+        # shadow + quality-scorer programs ride the same warm ladder: a
+        # first-sight compile inside a live dispatch would stall the loop
+        # exactly like an unwarmed packed step. The warm step's own
+        # post-state/decision outputs key the scorer's cache entry (same
+        # shapes and shardings as the live call).
+        sv = None
+        if self._shadow_fn is not None:
+            pen = jnp.zeros((self._n_pad,), jnp.int32)
+            if rate_on:
+                sv = self._shadow_fn((dummy_state(), buckets), buf, pen,
+                                     np.float32(0.0), wr, wh, wb)
+            else:
+                sv = self._shadow_fn(dummy_state(), buf, pen, wr, wh, wb)
+        step = getattr(self.quality, "_step", None)
+        if step is not None:
+            qs = init_quality_state(self._n_pad, self.quality.n_buckets)
+            req9 = np.zeros((9, wb), np.int32)
+            ewma = np.zeros(self._n_pad, np.float32)
+            caps = np.zeros(self._n_pad, np.int32)
+            step(qs, st_w.free_mb, st_w.conc_free, st_w.health, ewma,
+                 caps, req9, out_w, None)
+            if sv is not None:
+                step(qs, st_w.free_mb, st_w.conc_free, st_w.health, ewma,
+                     caps, req9, out_w, sv)
 
     def _warm_one(self, sig: tuple, fn) -> Optional[dict]:
         """One warm-drainer unit of work (worker thread): compile the
@@ -1097,6 +1234,8 @@ class TpuBalancer(CommonLoadBalancer):
         self._sched_fn, self._release_fn = sched, release
         self._packed_fn = decision["packed"]
         self._release_packed_fn = decision["release_packed"]
+        # the shadow twin tracks the production kernel family
+        self._build_shadow_fn()
         # fresh jit caches behind the installed fns: only the calibrated
         # signature is warm; successor shapes re-enter the drainer as
         # traffic hits them
@@ -2828,6 +2967,36 @@ class TpuBalancer(CommonLoadBalancer):
         buf = np.concatenate([rel_np.ravel(), health_np.ravel(),
                               req_np.ravel()])
         t_assembled = time.monotonic()
+        # shadow counterfactual (quality plane, every K batches): a
+        # decision-only pass over the SAME packed buffer, enqueued BEFORE
+        # the (possibly donating) production step so it reads the
+        # pre-step buffers off the device stream. It writes nothing back;
+        # `now` is hoisted and shared so the rate-admission fold (a pure
+        # function of buckets/now) reproduces the production admitted set
+        # exactly.
+        now32 = (np.float32(time.monotonic() - self._t0_mono)
+                 if rate_on else None)
+        shadow_out = None
+        if self._shadow_fn is not None:
+            self._quality_batches += 1
+            k = self.quality.shadow_every_n
+            if k > 0 and self._quality_batches % k == 0:
+                try:
+                    if rate_on:
+                        shadow_out = self._shadow_fn(
+                            (self.state, self._bucket_state), buf,
+                            self._shadow_penalty, now32,
+                            rel_np.shape[1], health_np.shape[1], bp)
+                    else:
+                        shadow_out = self._shadow_fn(
+                            self.state, buf, self._shadow_penalty,
+                            rel_np.shape[1], health_np.shape[1], bp)
+                except Exception as e:  # noqa: BLE001 — the shadow is
+                    # observability: it must never take placement down
+                    shadow_out = None
+                    if self.logger:
+                        self.logger.warn(None, f"shadow step failed: {e!r}",
+                                         "TpuBalancer")
         # host-observatory bracket: a GC pause landing inside this window
         # stalls the device dispatch — counting it here turns a mysterious
         # dispatch-stage outlier in the waterfall into an attributed cause
@@ -2835,8 +3004,7 @@ class TpuBalancer(CommonLoadBalancer):
         try:
             if rate_on:
                 (self.state, self._bucket_state), out = self._packed_fn(
-                    (self.state, self._bucket_state), buf,
-                    np.float32(time.monotonic() - self._t0_mono),
+                    (self.state, self._bucket_state), buf, now32,
                     rel_np.shape[1], health_np.shape[1], bp)
             else:
                 self.state, out = self._packed_fn(
@@ -2860,6 +3028,23 @@ class TpuBalancer(CommonLoadBalancer):
             return
         finally:
             GLOBAL_HOST_OBSERVATORY.end_dispatch()
+
+        # quality scoring (every batch when the plane is armed): one tiny
+        # read-only program over the POST-commit books, the decision
+        # vector and the anomaly EWMAs — enqueued async on the same
+        # stream; the summary row resolves on the readback worker
+        q_summary = None
+        if self.quality.enabled:
+            try:
+                q_summary = self.quality.device_step(
+                    self.state.free_mb, self.state.conc_free,
+                    self.state.health, self._quality_ewma,
+                    self._quality_caps, req_np[:9], out, shadow_out)
+            except Exception as e:  # noqa: BLE001 — scoring must never
+                # take the placement path down with it
+                if self.logger:
+                    self.logger.warn(None, f"quality step failed: {e!r}",
+                                     "TpuBalancer")
 
         # write-ahead journal: the state mutation above is committed on
         # the loop, so the record lands at exactly this point in mutation
@@ -2940,7 +3125,7 @@ class TpuBalancer(CommonLoadBalancer):
         books = self._books_ref()
         task = asyncio.get_event_loop().create_task(
             self._readback_step(batch, b, out, t0, req_np, rec, books,
-                                self._next_books_seq(), jseq))
+                                self._next_books_seq(), jseq, q_summary))
         self._readbacks.add(task)
         task.add_done_callback(self._readbacks.discard)
 
@@ -2969,7 +3154,7 @@ class TpuBalancer(CommonLoadBalancer):
 
     async def _readback_step(self, batch, b, out, t0, req_np, rec=None,
                              books_free=None, books_seq=0,
-                             journal_seq=0) -> None:
+                             journal_seq=0, q_summary=None) -> None:
         # the step-duration stamp is taken ON the worker thread so the
         # metric measures device step + readback, not loop re-scheduling
         def _read():
@@ -3003,6 +3188,26 @@ class TpuBalancer(CommonLoadBalancer):
                 rec.digest["occupancy"] = (
                     round(used / cap_total, 4) if cap_total else 0.0)
                 rec.timings["readback_ms"] = round(rb_ms, 3)
+            # quality summary: resolved here on the worker alongside the
+            # books it was computed from (the scorer program has had the
+            # whole readback round trip to complete)
+            if q_summary is not None:
+                try:
+                    s = np.asarray(q_summary)
+                    self.quality.note_summary(s)
+                    if rec is not None:
+                        rec.digest["quality"] = {
+                            "regret_ms": round(float(s[S_REGRET_SUM_MS]), 3),
+                            "imbalance_cov": round(
+                                float(s[S_IMBALANCE_COV]), 4),
+                            "divergent": int(s[S_DIVERGENT]),
+                        }
+                except Exception as e:  # noqa: BLE001 — a failed score
+                    # readout must not fail the batch readback
+                    if self.logger:
+                        self.logger.warn(
+                            None, f"quality summary failed: {e!r}",
+                            "TpuBalancer")
             return arrs, t_r1, free_np
 
         try:

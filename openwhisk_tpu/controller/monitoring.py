@@ -378,6 +378,73 @@ def merged_slo_report(raws) -> dict:
     }
 
 
+def merged_quality_report(raws) -> dict:
+    """`GET /admin/fleet/quality` body: regret histograms and attribution
+    counters sum positionally (bit-exact integer merge, same bucket grid
+    as the SLO plane), per-invoker regret/divergence series merge by
+    LABEL, then the fleet regret p99 re-derives from the MERGED histogram
+    — a fleet-level percentile from counts, never an average of
+    per-member p99s. Imbalance is a per-member shape statistic (CoV of
+    occupancy over that member's partition), so it stays per-member."""
+    from ..ops.telemetry import bucket_bounds_ms
+
+    raws = [r for r in raws if r.get("enabled")]
+    if not raws:
+        return {"enabled": False, "members": []}
+    nb = int(raws[0]["buckets"])
+    usable = [r for r in raws if int(r["buckets"]) == nb]
+    skipped = [r for r in raws if int(r["buckets"]) != nb]
+    bounds = bucket_bounds_ms(nb)
+
+    hist = [0] * nb
+    counter_names = list(raws[0].get("counter_names") or [])
+    counters = [0] * len(counter_names)
+    invokers: dict = {}
+    scalars = {"batches": 0, "shadow_batches": 0, "divergent_rows": 0,
+               "shadow_rows": 0}
+    regret_sum_ms = 0.0
+    imbalance = []
+    for r in usable:
+        _sum_into(hist, (r.get("regret_hist") or [])[:nb])
+        _sum_into(counters, (r.get("counters") or [])[:len(counters)])
+        for name, row in (r.get("invokers") or {}).items():
+            slot = invokers.setdefault(name, {"regret_ms": 0.0,
+                                              "divergent_rows": 0})
+            slot["regret_ms"] += float(row.get("regret_ms", 0.0))
+            slot["divergent_rows"] += int(row.get("divergence", 0))
+        for k in scalars:
+            scalars[k] += int(r.get(k, 0))
+        regret_sum_ms += float(r.get("regret_sum_ms", 0.0))
+        imbalance.append({
+            "identity": r.get("identity") or {},
+            "fleet_imbalance_cov": round(
+                float(r.get("fleet_imbalance_cov", 0.0)), 6),
+        })
+
+    bi = _pctl_from_hist(hist, 0.99)
+    return {
+        "enabled": True,
+        "members": _members_of(usable),
+        **({"members_skipped": _members_of(skipped)} if skipped else {}),
+        "buckets_le_ms": bounds,
+        "regret_hist": hist,
+        "regret_p99_le_ms": ((bounds[bi] if bi < len(bounds) else None)
+                             if sum(hist) else None),  # None: +Inf/empty
+        "regret_sum_ms": round(regret_sum_ms, 3),
+        **scalars,
+        "divergence_ratio": round(
+            scalars["divergent_rows"] / max(1, scalars["shadow_rows"]), 6),
+        "counters": {name: counters[i]
+                     for i, name in enumerate(counter_names)},
+        "invokers": [
+            {"invoker": name,
+             "regret_ms": round(slot["regret_ms"], 3),
+             "divergent_rows": slot["divergent_rows"]}
+            for name, slot in sorted(invokers.items())],
+        "imbalance_by_member": imbalance,
+    }
+
+
 def merged_host_report(raws) -> dict:
     """`GET /admin/fleet/host` body: loop-lag/gc histograms sum bucket-
     wise, stall/task/serde counters sum, percentiles re-derive from the

@@ -111,8 +111,19 @@ def set_health(state: PlacementState, idx, usable) -> PlacementState:
         jnp.asarray(usable)))
 
 
-def _schedule_one(state: PlacementState, req) -> Tuple[PlacementState, Tuple]:
-    """One activation: vectorized probe + capacity update (scan body)."""
+def _schedule_one(state: PlacementState, req, penalty=None
+                  ) -> Tuple[PlacementState, Tuple]:
+    """One activation: vectorized probe + capacity update (scan body).
+
+    `penalty` (optional int32[N], small non-negative levels) demotes an
+    invoker by one full lap of the probe ring per level: the augmented key
+    `rank + penalty * size` keeps the original probe order within a level
+    but probes every level-p invoker after all of level p-1. The sentinel
+    must then exceed any augmented key, so the penalized path swaps
+    `n + 2` for 2^30 (`rank < 2^17` and `penalty` is clipped small by the
+    caller, so no int32 overflow). `penalty=None` leaves the trace
+    bit-identical to the pre-penalty kernel.
+    """
     offset, size, home, step_inv, need, slot, max_conc, rand, valid = req
     n = state.free_mb.shape[0]
     big = jnp.int32(n + 2)
@@ -123,6 +134,9 @@ def _schedule_one(state: PlacementState, req) -> Tuple[PlacementState, Tuple]:
     size_safe = jnp.maximum(size, 1)
     # probe-order rank via modular inverse of the coprime step
     rank = _mulmod(local - home, step_inv, size_safe)
+    if penalty is not None:
+        big = jnp.int32(1 << 30)
+        rank = rank + penalty * size_safe
 
     conc_col = jax.lax.dynamic_index_in_dim(state.conc_free, slot, axis=1,
                                             keepdims=False)
@@ -157,14 +171,16 @@ def _schedule_one(state: PlacementState, req) -> Tuple[PlacementState, Tuple]:
 
 
 @jax.jit
-def schedule_batch(state: PlacementState, batch: RequestBatch
+def schedule_batch(state: PlacementState, batch: RequestBatch, penalty=None
                    ) -> Tuple[PlacementState, jax.Array, jax.Array]:
-    """Place a micro-batch sequentially (lax.scan) with vectorized probes."""
+    """Place a micro-batch sequentially (lax.scan) with vectorized probes.
+    `penalty=None` (the production default) traces identically to the
+    penalty-free kernel; see `_schedule_one` for the augmented geometry."""
     reqs = (batch.offset, batch.size, batch.home, batch.step_inv,
             batch.need_mb, batch.conc_slot, batch.max_conc, batch.rand,
             batch.valid)
     new_state, (chosen, forced) = jax.lax.scan(
-        lambda s, r: _schedule_one(s, r), state, reqs)
+        lambda s, r: _schedule_one(s, r, penalty), state, reqs)
     return new_state, chosen, forced
 
 
@@ -388,13 +404,20 @@ def repair_commit_masks(prims: RepairPrims, *, pending, placed, forced, sel,
     return safe, safe & placed
 
 
-def _probe_geometry(n: int, batch: RequestBatch):
+def _probe_geometry(n: int, batch: RequestBatch, penalty=None):
     """The state-INDEPENDENT part of the batch probe, hoisted out of the
     repair loop: partition masks, probe ranks and the forced-placement
     choice (health never changes inside a batch — the fold runs before the
     schedule — so the whole forced path is loop-invariant too... except
     health, which the caller folds in). Returns [B, N] rank/in_part and the
-    per-request forced rotation key."""
+    per-request forced rotation key.
+
+    `penalty` (optional int32[N]) augments the rank by one probe-ring lap
+    per penalty level — the loop-invariant seam every repair-family kernel
+    (XLA, Pallas, sharded) shares, so threading it here penalizes them all
+    identically. The penalized sentinel grows to 2^30 because an augmented
+    rank can exceed n + 2; forced-rotation keys stay < size, so the larger
+    sentinel is equally correct for them."""
     big = jnp.int32(n + 2)
     idx = jnp.arange(n, dtype=jnp.int32)
     local = idx[None, :] - batch.offset[:, None]          # [B, N]
@@ -403,12 +426,16 @@ def _probe_geometry(n: int, batch: RequestBatch):
     size_safe = jnp.maximum(size_col, 1)
     rank = _mulmod(local - batch.home[:, None], batch.step_inv[:, None],
                    size_safe)
+    if penalty is not None:
+        big = jnp.int32(1 << 30)
+        rank = rank + penalty[None, :] * size_safe
     fkey_rot = jnp.mod(local - batch.rand[:, None], size_safe)
     return big, in_part, rank, fkey_rot
 
 
 @jax.jit
-def schedule_batch_repair(state: PlacementState, batch: RequestBatch
+def schedule_batch_repair(state: PlacementState, batch: RequestBatch,
+                          penalty=None
                           ) -> Tuple[PlacementState, jax.Array, jax.Array,
                                      jax.Array]:
     """Speculate-and-repair: bit-exact `schedule_batch` semantics with the
@@ -477,7 +504,7 @@ def schedule_batch_repair(state: PlacementState, batch: RequestBatch
     # capacity — `usable` never moves between repair rounds)
     n = state.free_mb.shape[0]
     a_slots = state.conc_free.shape[1]
-    big, in_part, rank, fkey_rot = _probe_geometry(n, batch)
+    big, in_part, rank, fkey_rot = _probe_geometry(n, batch, penalty)
     usable = in_part & state.health[None, :]
     fkey = jnp.where(usable, fkey_rot, big)
     fchoice = jnp.argmin(fkey, axis=1).astype(jnp.int32)
@@ -792,6 +819,76 @@ def make_fused_admit_step_packed(release_fn=None, schedule_fn=None,
         return (state, buckets), jnp.concatenate([out, rounds.reshape(1)])
 
     return packed
+
+
+def make_shadow_step_packed(release_fn=None, schedule_fn=None):
+    """Decision-only counterfactual twin of make_fused_step_packed: same
+    packed buffer, same release/health folds, but the schedule runs with an
+    augmented probe geometry (`penalty` int32[N]) and NOTHING it computes
+    is written back — the caller keeps its live state, this program returns
+    only the packed decision vector ((chosen+1)<<2 | forced, no repair-round
+    tail). Never donates: the production step consumes (and may donate) the
+    very same state buffers after the shadow has enqueued, so the shadow
+    must leave them untouched.
+
+    `schedule_fn(state, batch, penalty)` defaults to the scan kernel;
+    callers pass the penalty-aware variant matching their production kernel
+    so divergence measures the PENALTY, not a kernel family change.
+    """
+    release_fn = release_fn or release_batch
+    schedule_fn = schedule_fn or schedule_batch
+
+    @partial(jax.jit, static_argnums=(3, 4, 5))
+    def shadow(state: PlacementState, buf, penalty, R: int, H: int, B: int):
+        rel = buf[:5 * R].reshape(5, R)
+        health = buf[5 * R:5 * R + 3 * H].reshape(3, H)
+        req = buf[5 * R + 3 * H:].reshape(9, B)
+        state = release_fn(state, rel[0], rel[1], rel[2], rel[3],
+                           rel[4].astype(bool))
+        cur = state.health[health[0]]
+        state = state._replace(health=state.health.at[health[0]].set(
+            jnp.where(health[2].astype(bool), health[1].astype(bool), cur)))
+        batch = RequestBatch(req[0], req[1], req[2], req[3], req[4], req[5],
+                             req[6], req[7], req[8].astype(bool))
+        out = schedule_fn(state, batch, penalty)
+        return ((out[1] + 1) << 2) | out[2].astype(jnp.int32)
+
+    return shadow
+
+
+def make_shadow_admit_step_packed(release_fn=None, schedule_fn=None):
+    """Shadow twin of make_fused_admit_step_packed (rate limiting on): the
+    admission fold re-runs against the SAME bucket state and `now` as the
+    production step — admit_batch is a pure function, so the admitted set
+    is identical — but neither the buckets nor the placement state are
+    returned. Output encodes throttled in bit 1 like the production step.
+    """
+    from .throttle import admit_batch
+
+    release_fn = release_fn or release_batch
+    schedule_fn = schedule_fn or schedule_batch
+
+    @partial(jax.jit, static_argnums=(4, 5, 6))
+    def shadow(carry, buf, penalty, now, R: int, H: int, B: int):
+        state, buckets = carry
+        rel = buf[:5 * R].reshape(5, R)
+        health = buf[5 * R:5 * R + 3 * H].reshape(3, H)
+        req = buf[5 * R + 3 * H:].reshape(10, B)
+        valid = req[8].astype(bool)
+        _, admitted = admit_batch(buckets, now, req[9], valid)
+        throttled = valid & ~admitted
+        state = release_fn(state, rel[0], rel[1], rel[2], rel[3],
+                           rel[4].astype(bool))
+        cur = state.health[health[0]]
+        state = state._replace(health=state.health.at[health[0]].set(
+            jnp.where(health[2].astype(bool), health[1].astype(bool), cur)))
+        batch = RequestBatch(req[0], req[1], req[2], req[3], req[4], req[5],
+                             req[6], req[7], admitted)
+        out = schedule_fn(state, batch, penalty)
+        return (((out[1] + 1) << 2) | (throttled.astype(jnp.int32) << 1)
+                | out[2].astype(jnp.int32))
+
+    return shadow
 
 
 def unpack_chosen(out):

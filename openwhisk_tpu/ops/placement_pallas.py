@@ -151,11 +151,14 @@ def to_transposed(state: PlacementState) -> PlacementState:
                           state.health)
 
 
-def _kernel(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref, forced_ref,
-            free_out, conc_out):
+def _kernel_body(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref,
+                 forced_ref, free_out, conc_out, pen_ref=None):
     n = free_out.shape[1]
     b = chosen_ref.shape[1]
-    big = jnp.int32(n + 2)
+    # the penalized rank can exceed n + 2 (one probe-ring lap per penalty
+    # level), so the penalized variant needs the larger sentinel — same
+    # rule as ops.placement._schedule_one
+    big = jnp.int32(n + 2) if pen_ref is None else jnp.int32(1 << 30)
     idx = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
     bidx = jax.lax.broadcasted_iota(jnp.int32, (1, b), 1)
 
@@ -181,6 +184,8 @@ def _kernel(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref, forced_ref,
         in_part = (local >= 0) & (local < size)
         m = jnp.maximum(size, 1)
         rank = _mulmod(local - home, step_inv, m)
+        if pen_ref is not None:
+            rank = rank + pen_ref[:] * m
 
         healthy = health_ref[:] > 0
         conc_row = conc_out[pl.ds(slot, 1), :]
@@ -223,11 +228,26 @@ def _kernel(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref, forced_ref,
     jax.lax.fori_loop(0, b, body, 0)
 
 
+def _kernel(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref, forced_ref,
+            free_out, conc_out):
+    _kernel_body(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref,
+                 forced_ref, free_out, conc_out)
+
+
+def _kernel_penalized(reqs_ref, health_ref, free_ref, conc_ref, pen_ref,
+                      chosen_ref, forced_ref, free_out, conc_out):
+    _kernel_body(reqs_ref, health_ref, free_ref, conc_ref, chosen_ref,
+                 forced_ref, free_out, conc_out, pen_ref=pen_ref)
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def schedule_batch_pallas(state: PlacementState, batch: RequestBatch,
-                          interpret: bool = False
+                          interpret: bool = False, penalty=None
                           ) -> Tuple[PlacementState, jax.Array, jax.Array]:
-    """Drop-in for schedule_batch, state in transposed ([A, N]) layout."""
+    """Drop-in for schedule_batch, state in transposed ([A, N]) layout.
+    `penalty=None` traces the original kernel unchanged; a penalty vector
+    appends one [1, N] VMEM input AFTER the aliased state buffers, so the
+    input_output_aliases indices are identical in both variants."""
     n = state.free_mb.shape[0]
     a = state.conc_free.shape[0]
     b = batch.offset.shape[0]
@@ -243,31 +263,40 @@ def schedule_batch_pallas(state: PlacementState, batch: RequestBatch,
     free2 = state.free_mb.reshape(1, n)
     health2 = state.health.astype(jnp.int32).reshape(1, n)
 
-    chosen, forced, free_o, conc_o = pl.pallas_call(
-        _kernel,
-        out_shape=(jax.ShapeDtypeStruct((1, b), jnp.int32),
-                   jax.ShapeDtypeStruct((1, b), jnp.int32),
-                   jax.ShapeDtypeStruct((1, n), jnp.int32),
-                   jax.ShapeDtypeStruct((a, n), jnp.int32)),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM)),
-        input_output_aliases={2: 2, 3: 3},
-        interpret=interpret,
-    )(reqs, health2, free2, state.conc_free)
+    out_shape = (jax.ShapeDtypeStruct((1, b), jnp.int32),
+                 jax.ShapeDtypeStruct((1, b), jnp.int32),
+                 jax.ShapeDtypeStruct((1, n), jnp.int32),
+                 jax.ShapeDtypeStruct((a, n), jnp.int32))
+    out_specs = (pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM))
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM)]
+    if penalty is None:
+        chosen, forced, free_o, conc_o = pl.pallas_call(
+            _kernel, out_shape=out_shape, in_specs=in_specs,
+            out_specs=out_specs, input_output_aliases={2: 2, 3: 3},
+            interpret=interpret,
+        )(reqs, health2, free2, state.conc_free)
+    else:
+        chosen, forced, free_o, conc_o = pl.pallas_call(
+            _kernel_penalized, out_shape=out_shape,
+            in_specs=in_specs + [pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=out_specs, input_output_aliases={2: 2, 3: 3},
+            interpret=interpret,
+        )(reqs, health2, free2, state.conc_free,
+          penalty.astype(jnp.int32).reshape(1, n))
 
     new_state = PlacementState(free_o.reshape(n), conc_o, state.health)
     return new_state, chosen.reshape(b), forced.reshape(b) > 0
 
 
-def _repair_kernel(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
-                   chosen_ref, forced_ref, rounds_ref, free_out, conc_out,
-                   conc_bn_ref):
+def _repair_kernel_body(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
+                        chosen_ref, forced_ref, rounds_ref, free_out,
+                        conc_out, conc_bn_ref, pen_ref=None):
     """Speculate-and-repair in ONE kernel: full-batch probe, the shared
     conflict rules (ops.placement.repair_commit_masks with the pairwise
     prims), scatter-commit, and the residue loop — all with the fleet
@@ -281,7 +310,9 @@ def _repair_kernel(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
     `reqs_v_ref` in VMEM (column vectors for the batch math)."""
     n = free_out.shape[1]
     b = chosen_ref.shape[1]
-    big = jnp.int32(n + 2)
+    # penalized ranks can exceed n + 2: larger sentinel, same rule as the
+    # XLA _probe_geometry
+    big = jnp.int32(n + 2) if pen_ref is None else jnp.int32(1 << 30)
     idx_bn = jax.lax.broadcasted_iota(jnp.int32, (b, n), 1)
     bidx_col = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
     eye_bb = (jax.lax.broadcasted_iota(jnp.int32, (b, b), 0)
@@ -313,7 +344,10 @@ def _repair_kernel(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
     m = jnp.maximum(size, 1)
     healthy = health_ref[:] > 0                      # [1, N]
     usable = in_part & healthy
-    geom_key = jnp.where(usable, _mulmod(local - home, step_inv, m), big)
+    geom_rank = _mulmod(local - home, step_inv, m)
+    if pen_ref is not None:
+        geom_rank = geom_rank + pen_ref[:] * m
+    geom_key = jnp.where(usable, geom_rank, big)
     fkey = jnp.where(usable, jnp.mod(local - rand, m), big)
     fmin = jnp.min(fkey, axis=1, keepdims=True)
     fchoice = jnp.min(jnp.where(fkey == fmin, idx_bn, big), axis=1,
@@ -410,9 +444,25 @@ def _repair_kernel(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
     rounds_ref[0, 0] = rounds
 
 
+def _repair_kernel(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
+                   chosen_ref, forced_ref, rounds_ref, free_out, conc_out,
+                   conc_bn_ref):
+    _repair_kernel_body(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
+                        chosen_ref, forced_ref, rounds_ref, free_out,
+                        conc_out, conc_bn_ref)
+
+
+def _repair_kernel_penalized(reqs_ref, reqs_v_ref, health_ref, free_ref,
+                             conc_ref, pen_ref, chosen_ref, forced_ref,
+                             rounds_ref, free_out, conc_out, conc_bn_ref):
+    _repair_kernel_body(reqs_ref, reqs_v_ref, health_ref, free_ref, conc_ref,
+                        chosen_ref, forced_ref, rounds_ref, free_out,
+                        conc_out, conc_bn_ref, pen_ref=pen_ref)
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def schedule_batch_repair_pallas(state: PlacementState, batch: RequestBatch,
-                                 interpret: bool = False
+                                 interpret: bool = False, penalty=None
                                  ) -> Tuple[PlacementState, jax.Array,
                                             jax.Array, jax.Array]:
     """Drop-in for ops.placement.schedule_batch_repair (state in the
@@ -438,27 +488,39 @@ def schedule_batch_repair_pallas(state: PlacementState, batch: RequestBatch,
     free2 = state.free_mb.reshape(1, n)
     health2 = state.health.astype(jnp.int32).reshape(1, n)
 
-    chosen, forced, rounds, free_o, conc_o = pl.pallas_call(
-        _repair_kernel,
-        out_shape=(jax.ShapeDtypeStruct((1, b), jnp.int32),
-                   jax.ShapeDtypeStruct((1, b), jnp.int32),
-                   jax.ShapeDtypeStruct((1, 1), jnp.int32),
-                   jax.ShapeDtypeStruct((1, n), jnp.int32),
-                   jax.ShapeDtypeStruct((a, n), jnp.int32)),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.SMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM)),
-        scratch_shapes=[pltpu.VMEM((b, n), jnp.int32)],
-        input_output_aliases={3: 3, 4: 4},
-        interpret=interpret,
-    )(reqs, reqs, health2, free2, state.conc_free)
+    out_shape = (jax.ShapeDtypeStruct((1, b), jnp.int32),
+                 jax.ShapeDtypeStruct((1, b), jnp.int32),
+                 jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                 jax.ShapeDtypeStruct((1, n), jnp.int32),
+                 jax.ShapeDtypeStruct((a, n), jnp.int32))
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM)]
+    out_specs = (pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pltpu.SMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM))
+    if penalty is None:
+        chosen, forced, rounds, free_o, conc_o = pl.pallas_call(
+            _repair_kernel, out_shape=out_shape, in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[pltpu.VMEM((b, n), jnp.int32)],
+            input_output_aliases={3: 3, 4: 4},
+            interpret=interpret,
+        )(reqs, reqs, health2, free2, state.conc_free)
+    else:
+        chosen, forced, rounds, free_o, conc_o = pl.pallas_call(
+            _repair_kernel_penalized, out_shape=out_shape,
+            in_specs=in_specs + [pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=out_specs,
+            scratch_shapes=[pltpu.VMEM((b, n), jnp.int32)],
+            input_output_aliases={3: 3, 4: 4},
+            interpret=interpret,
+        )(reqs, reqs, health2, free2, state.conc_free,
+          penalty.astype(jnp.int32).reshape(1, n))
 
     new_state = PlacementState(free_o.reshape(n), conc_o, state.health)
     return (new_state, chosen.reshape(b), forced.reshape(b) > 0,

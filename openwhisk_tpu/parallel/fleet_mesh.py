@@ -107,7 +107,8 @@ def mesh_topology(mesh: Optional[Mesh]) -> dict:
             "platform": mesh.devices.flat[0].platform}
 
 
-def make_fleet_repair_schedule(mesh: Mesh, axis: Optional[str] = None):
+def make_fleet_repair_schedule(mesh: Mesh, axis: Optional[str] = None,
+                               penalized: bool = False):
     """The speculate-and-repair schedule over the fleet mesh — bit-exact
     `schedule_batch_repair` semantics (state, chosen, forced, rounds) with
     the [B, N] probe sharded to [B, n_local] per device.
@@ -133,18 +134,25 @@ def make_fleet_repair_schedule(mesh: Mesh, axis: Optional[str] = None):
         the while_loop stays coherent across the mesh.
       * commit — owner-masked scatter-adds (zero deltas elsewhere; a
         zero add at a clipped index is a no-op).
+
+    `penalized=True` builds the counterfactual variant: the returned fn
+    takes a third argument, a global int32[N] penalty vector (sharded like
+    the books), folded into the loop-invariant geometry as one probe-ring
+    lap per level — the same seam the XLA/Pallas kernels thread, so all
+    three families penalize identically. The sentinel grows to 2^30
+    because augmented ranks can exceed n_total + 2.
     """
     axis = axis or mesh_axis(mesh)
     n_shards = mesh_shards(mesh)
 
-    def _sharded(state: PlacementState, batch: RequestBatch):
+    def _sharded(state: PlacementState, batch: RequestBatch, penalty=None):
         b = batch.valid.shape[0]
         prims = flat_prims(b)
         n_local = state.free_mb.shape[0]
         n_total = n_local * n_shards
         a_slots = state.conc_free.shape[1]
         off = jax.lax.axis_index(axis).astype(jnp.int32) * n_local
-        big = jnp.int32(n_total + 2)
+        big = jnp.int32(n_total + 2) if penalty is None else jnp.int32(1 << 30)
 
         # loop-invariant LOCAL geometry: this shard's slice of the
         # [B, N] rank/partition math (ops.placement._probe_geometry)
@@ -155,6 +163,8 @@ def make_fleet_repair_schedule(mesh: Mesh, axis: Optional[str] = None):
         size_safe = jnp.maximum(size_col, 1)
         rank = _mulmod(local - batch.home[:, None], batch.step_inv[:, None],
                        size_safe)
+        if penalty is not None:
+            rank = rank + penalty[None, :] * size_safe
         usable = in_part & state.health[None, :]
 
         def _elect(key_loc):
@@ -245,10 +255,16 @@ def make_fleet_repair_schedule(mesh: Mesh, axis: Optional[str] = None):
 
     state_spec = PlacementState(P(axis), P(axis, None), P(axis))
     batch_spec = RequestBatch(*([P()] * 9))
-    fn = shard_map(_sharded, mesh=mesh,
-                   in_specs=(state_spec, batch_spec),
-                   out_specs=(state_spec, P(), P(), P()),
-                   check_vma=False)
+    if penalized:
+        fn = shard_map(_sharded, mesh=mesh,
+                       in_specs=(state_spec, batch_spec, P(axis)),
+                       out_specs=(state_spec, P(), P(), P()),
+                       check_vma=False)
+    else:
+        fn = shard_map(lambda s, b: _sharded(s, b), mesh=mesh,
+                       in_specs=(state_spec, batch_spec),
+                       out_specs=(state_spec, P(), P(), P()),
+                       check_vma=False)
     return jax.jit(fn)
 
 

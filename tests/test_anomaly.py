@@ -380,7 +380,8 @@ class TestStragglerEndToEnd:
             # higher: 16 in-flight actions x 0.6 s once pushed a round
             # past the supervision silence window and took the fleet
             # offline mid-test.)
-            invokers[3].delay = 0.25
+            from tools.loadgen import apply_stragglers
+            assert apply_stragglers(invokers, "3:0.25") == {3: 0.25}
             for _ in range(4):
                 await round_trip()
             await settle()
@@ -389,7 +390,7 @@ class TestStragglerEndToEnd:
             alerts1 = plane.alerts_report()
             text1 = bal.metrics.prometheus_text()
             # recovery: the slow invoker speeds back up
-            invokers[3].delay = 0.0
+            apply_stragglers(invokers, {3: 0.0})
             for _ in range(6):
                 await round_trip()
                 await settle(1)

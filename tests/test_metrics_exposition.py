@@ -456,6 +456,76 @@ class TestOpenMetricsExemplars:
         validate_exposition(txt_text)
 
 
+class TestPlacementQualityFamilies:
+    """ISSUE 17: the placement-quality plane's three families pass the
+    same exposition grammar as the live page — the regret histogram on
+    the telemetry bucket grid (strictly increasing `le`, monotone
+    cumulative counts, `+Inf` == `_count`), the per-invoker divergence
+    counter (with OM `_total` negotiation), and the imbalance gauge."""
+
+    def _plane(self):
+        import numpy as np
+
+        from openwhisk_tpu.controller.loadbalancer.quality import (
+            QualityConfig, QualityPlane)
+        from openwhisk_tpu.ops.decision_quality import (N_SUMMARY,
+                                                        S_IMBALANCE_COV,
+                                                        S_ROWS,
+                                                        init_quality_state)
+        qp = QualityPlane(QualityConfig(enabled=True))
+        qs = init_quality_state(4, qp.n_buckets, numpy=True)
+        qs.regret_hist[0] = 3
+        qs.regret_hist[5] = 2
+        qs.inv_regret_ms[1] = 12.5
+        qs.inv_divergence[1] = 3
+        qs.counters[0] = 5
+        qp._qstate = qs
+        s = np.zeros(N_SUMMARY, np.float32)
+        s[S_ROWS] = 5
+        s[S_IMBALANCE_COV] = 0.25
+        qp.note_summary(s)
+        return qp
+
+    def test_families_pass_exposition_grammar(self):
+        qp = self._plane()
+        # a label value that needs escaping must not corrupt a line
+        text = qp.prometheus_text(["inv0", 'inv"one\\two'])
+        out = validate_exposition(text)
+        types = out["types"]
+        assert types[
+            "openwhisk_loadbalancer_placement_regret"] == "histogram"
+        assert types[
+            "openwhisk_loadbalancer_decision_divergence_total"] == "counter"
+        assert types["openwhisk_loadbalancer_fleet_imbalance"] == "gauge"
+        # the regret histogram accumulated both synthetic rows
+        hist = [v for k, v in out["histograms"].items()
+                if k[0] == "openwhisk_loadbalancer_placement_regret"]
+        assert hist and hist[0][-1] == (float("inf"), 5.0)
+        # only the divergent invoker renders a counter row, with its
+        # escaped label value intact
+        div_lines = [ln for ln in text.splitlines() if ln.startswith(
+            "openwhisk_loadbalancer_decision_divergence_total{")]
+        assert len(div_lines) == 1
+        assert parse_labels(
+            div_lines[0].split("{", 1)[1].rsplit("}", 1)[0]
+        ) == {"invoker": 'inv"one\\two'}
+        assert ('openwhisk_loadbalancer_fleet_imbalance{scope="fleet"} '
+                "0.25") in text
+
+    def test_openmetrics_counter_negotiation(self):
+        qp = self._plane()
+        om = qp.prometheus_text(["inv0", "inv1"], openmetrics=True)
+        assert ("# TYPE openwhisk_loadbalancer_decision_divergence "
+                "counter") in om
+        assert "openwhisk_loadbalancer_decision_divergence_total{" in om
+
+    def test_disabled_plane_renders_nothing(self):
+        from openwhisk_tpu.controller.loadbalancer.quality import (
+            QualityConfig, QualityPlane)
+        qp = QualityPlane(QualityConfig(enabled=False))
+        assert qp.prometheus_text(["inv0"]) == ""
+
+
 class TestOpenMetricsCounterNaming:
     """Unit twin of the live OM-page counter check: both render paths
     (the family helpers and MetricEmitter's own counters) switch to

@@ -223,8 +223,14 @@ class TestMembershipPartitions:
             before = {i: set(ms[i].owned_partitions) for i in (0, 1)}
             ms[2] = _membership(provider, 2, ring, events)
             want2 = set(ring.partitions_of(2, [0, 1, 2]))
-            ok = await until(lambda: ms[2].owned_partitions == want2,
-                             timeout=12.0)
+            # converged = the joiner claimed its rendezvous set AND the
+            # old owners demoted theirs — waiting on the joiner alone
+            # races the snapshot against the in-flight demotions
+            ok = await until(
+                lambda: (ms[2].owned_partitions == want2
+                         and ms[0].owned_partitions == before[0] - want2
+                         and ms[1].owned_partitions == before[1] - want2),
+                timeout=12.0)
             after = {i: set(ms[i].owned_partitions) for i in (0, 1, 2)}
             for m in ms.values():
                 await m.stop()

@@ -84,6 +84,16 @@ HEADLINES = (
     ("fleet_merged_sustained_per_sec",
      ("e2e_open_loop", "multiproc_point", "fleet_merged_sustained_per_sec"),
      "higher"),
+    # ISSUE 17: placement quality under the straggler A/B — predicted
+    # regret left on the table and how often the penalized shadow would
+    # have placed differently (both lower-is-better), plus the plane's
+    # <= 5% paired-overhead gate
+    ("placement_regret_p99_ms",
+     ("placement_quality", "straggler", "regret_p99_le_ms"), "lower"),
+    ("shadow_divergence_ratio",
+     ("placement_quality", "shadow_divergence_ratio"), "lower"),
+    ("placement_quality_overhead_pct",
+     ("placement_quality_overhead", "overhead_pct"), "lower"),
 )
 
 

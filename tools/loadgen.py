@@ -53,6 +53,43 @@ MAX_FIRE_LAG_MS = 50.0           #: generator max lateness vs its schedule
 DRAIN_TIMEOUT_S = 15.0
 
 
+def parse_stragglers(spec) -> dict:
+    """`--stragglers` SPEC -> {invoker_index: ack_delay_seconds}.
+
+    SPEC is `IDX:DELAY_S[,IDX:DELAY_S...]` (e.g. `3:0.25` delays invoker
+    3's acks by 250 ms — the PR 4 acceptance scenario's numbers); a bare
+    `IDX` defaults to 0.25 s. Dicts pass through normalized, None/empty
+    means no injection."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        return {int(k): float(v) for k, v in spec.items()}
+    out = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        idx, _, delay = part.partition(":")
+        out[int(idx)] = float(delay) if delay else 0.25
+    return out
+
+
+def apply_stragglers(invokers, spec) -> dict:
+    """PR 4's straggler injection, extracted to ONE helper: set `.delay`
+    on the indexed invoker stand-ins. The test SimInvokers and bench.py's
+    echo feeds expose the same mutable attribute, so the anomaly e2e
+    tests, the `placement_quality` bench rider and manual loadgen drives
+    all inject through this path. Returns the applied {index: delay_s}
+    map (out-of-range indexes are dropped) — report it next to the
+    numbers it skews."""
+    applied = {}
+    for idx, delay in sorted(parse_stragglers(spec).items()):
+        if 0 <= idx < len(invokers):
+            invokers[idx].delay = delay
+            applied[idx] = delay
+    return applied
+
+
 def make_schedule(rate: float, n: int, dist: str = "poisson",
                   seed: int = 1) -> List[float]:
     """Arrival offsets (seconds from t0) for `n` requests at `rate`/s.
@@ -258,12 +295,14 @@ class _BalancerTarget:
 
     def __init__(self, n_invokers: int = 16, kernel: str = "auto",
                  waterfall: bool = True, prewarm: bool = False,
-                 fleet_mesh: bool = False):
+                 fleet_mesh: bool = False, stragglers=None):
         self.n_invokers = n_invokers
         self.kernel = kernel
         self.waterfall = waterfall
         self.prewarm = prewarm
         self.fleet_mesh = fleet_mesh
+        self.stragglers = stragglers
+        self.stragglers_applied: dict = {}
         self.bal = None
         self._fleet_stop = None
         self._feeds = None
@@ -297,6 +336,11 @@ class _BalancerTarget:
         await self.bal.start()
         self._feeds, self._fleet_stop = await bench._echo_fleet(
             provider, self.n_invokers)
+        # straggler injection (shared PR 4 idiom): delay the indexed echo
+        # feeds' acks — the run's numbers then carry the skew they came
+        # from in the JSON line (`stragglers`)
+        self.stragglers_applied = apply_stragglers(self._feeds,
+                                                   self.stragglers)
         for _ in range(120):
             health = await self.bal.invoker_health()
             if sum(h.status == HEALTHY for h in health) >= self.n_invokers:
@@ -374,7 +418,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                    host_observatory: Optional[bool] = None,
                    gc_tune: bool = True, fleet_mesh: bool = False,
                    keep_samples: bool = False,
-                   worker_ident: Optional[int] = None) -> dict:
+                   worker_ident: Optional[int] = None,
+                   stragglers=None) -> dict:
     """The observatory: sweep offered rate (doubling from `rate0`) to the
     max sustainable throughput, then re-measure that rate for the headline
     row + the waterfall's per-stage budget. `fixed_rate` skips the sweep
@@ -411,7 +456,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                 GLOBAL_HOST_OBSERVATORY.reset()
                 obs_installed = GLOBAL_HOST_OBSERVATORY.install()
         target = _BalancerTarget(n_invokers=n_invokers, kernel=kernel,
-                                 waterfall=waterfall, fleet_mesh=fleet_mesh)
+                                 waterfall=waterfall, fleet_mesh=fleet_mesh,
+                                 stragglers=stragglers)
         await target.start()
         gc_tuned = None
         if gc_tune:
@@ -573,6 +619,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                 "mode": "open_loop",
                 "dist": dist,
                 "gc_tuned": gc_tuned,
+                "stragglers": {str(k): v for k, v
+                               in target.stragglers_applied.items()},
                 "fleet_mesh": bool(fleet_mesh),
                 "fleet_shards": getattr(target.bal, "n_shards", 1),
                 "sustained": bool(head["sustainable"]
@@ -808,6 +856,11 @@ def main() -> None:
                     help="(set by the --procs parent) this worker's fleet "
                          "identity instance; stamps identity blocks and "
                          "emits host_raw for the parent's exact merge")
+    ap.add_argument("--stragglers", default=None,
+                    help="inject ack-delay stragglers into the echo fleet: "
+                         "'IDX:DELAY_S[,IDX:DELAY_S...]' (bare IDX = "
+                         "0.25 s); the applied map is reported in the "
+                         "JSON line")
     ap.add_argument("--fleet-mesh", action="store_true",
                     help="run the target balancer in fleet-mesh mode "
                          "(CONFIG_whisk_loadBalancer_fleetMesh semantics; "
@@ -818,6 +871,10 @@ def main() -> None:
             if args.rate is None:
                 ap.error("--procs requires --rate (fixed-rate "
                          "measurement; sweeps stay single-process)")
+            if args.stragglers:
+                ap.error("--stragglers is single-process only (each "
+                         "--procs worker drives its own fleet twin, so "
+                         "a shared straggler index is meaningless)")
             out = multiproc_fixed_rate(
                 rate=args.rate, procs=args.procs, duration=args.duration,
                 p99_bound_ms=args.p99_bound_ms, dist=args.dist,
@@ -840,7 +897,8 @@ def main() -> None:
                                  gc_tune=not args.no_gc_tune,
                                  fleet_mesh=args.fleet_mesh,
                                  keep_samples=args.emit_samples,
-                                 worker_ident=args.worker_ident)
+                                 worker_ident=args.worker_ident,
+                                 stragglers=args.stragglers)
     except Exception as e:  # noqa: BLE001 — one parseable line, always
         import traceback
         traceback.print_exc(file=sys.stderr)
