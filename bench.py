@@ -212,7 +212,7 @@ def _bench_action(name, memory=256):
     return a
 
 
-async def _echo_invoker(provider, instance, delay=0.0):
+async def _echo_invoker(provider, instance, delay=0.0, on_frame=None):
     """An invoker stand-in: consumes its topic, acks every activation
     immediately with a successful record (pure control-plane load). Rides
     the same batch wire as the real InvokerReactive: a columnar dispatch
@@ -222,7 +222,11 @@ async def _echo_invoker(provider, instance, delay=0.0):
     `delay` rides as a mutable attribute on the returned feed (the PR 4
     SimInvoker idiom, so tools/loadgen.py's `apply_stragglers` drives
     test stubs and bench feeds through the same knob): a straggler's
-    acks sleep `feed.delay` seconds before flushing."""
+    acks sleep `feed.delay` seconds before flushing.
+
+    `on_frame(instance, msgs)` is a synchronous per-frame hook (the
+    trace-assembly rider emits invoker-side spans from it, standing in
+    for the real InvokerReactive's container span pair)."""
     from openwhisk_tpu.core.entity import (ActivationResponse, EntityPath,
                                            WhiskActivation)
     from openwhisk_tpu.messaging import (ActivationMessage,
@@ -247,6 +251,8 @@ async def _echo_invoker(provider, instance, delay=0.0):
         else:
             msgs = [decode_message(ActivationMessage.parse, payload,
                                    "activation")]
+        if on_frame is not None:
+            on_frame(instance, msgs)
         now = time.time()
         by_topic = {}
         for msg in msgs:
@@ -278,7 +284,7 @@ async def _echo_invoker(provider, instance, delay=0.0):
     return feed
 
 
-async def _echo_fleet(provider, n_invokers, stragglers=None):
+async def _echo_fleet(provider, n_invokers, stragglers=None, on_frame=None):
     """Start `n_invokers` echo invokers + a 1 Hz pinger (supervision marks a
     fleet Offline after 10 s of silence, which a cold first compile easily
     outlasts). Returns (feeds, stop) — await stop() to end the pinger.
@@ -296,7 +302,8 @@ async def _echo_fleet(provider, n_invokers, stragglers=None):
         inst = InvokerInstanceId(i, user_memory=MB(8192))
         instances.append(inst)
         feeds.append(await _echo_invoker(provider, inst,
-                                         delay=slow.get(i, 0.0)))
+                                         delay=slow.get(i, 0.0),
+                                         on_frame=on_frame))
         await producer.send("health", PingMessage(inst))
     stop_ping = asyncio.Event()
 
@@ -842,6 +849,546 @@ def _fleet_observatory_overhead(repeats: int = 20, total: int = 1000,
         if _backend_unavailable(e):
             raise  # the fallback runner re-runs this rider on CPU
         print(f"# fleet_observatory_overhead failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _trace_assembly(clean: int = 192, stragglers_n: int = 12,
+                    n_invokers: int = 8) -> Optional[dict]:
+    """ISSUE 18 acceptance: a spillover burst with injected stragglers
+    through the tail-sampled trace observatory, four legs in one fixture:
+
+      clean bulk   reason-free traffic keeps at the deterministic floor
+                   (keep_floor=0.05 -> every 20th completion);
+      stragglers   a delayed-fleet salvo lands above the live tail
+                   threshold -> 100% kept with reason `slow`;
+      spillover    non-blocking overflow diverts b0 -> b1; every spilled
+                   trace is kept, and at least one assembles into a tree
+                   spanning >= 3 processes whose origin stage spans
+                   telescope to the waterfall total;
+      dead peer    GET /admin/trace/{id} through a real Controller with
+                   a dead member answers 200 + members_missing (never a
+                   500), and every OpenMetrics exemplar rendered during
+                   the run resolves to a kept trace.
+    """
+    import base64
+    import dataclasses
+    import re
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from openwhisk_tpu.controller.core import Controller
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+    from openwhisk_tpu.controller.loadbalancer.lean import LeanBalancer
+    from openwhisk_tpu.controller.loadbalancer.partitions import PartitionRing
+    from openwhisk_tpu.controller.loadbalancer.spillover import (
+        SpilloverReceiver, SpilloverSender)
+    from openwhisk_tpu.core.entity import (MB, ActivationId,
+                                           ControllerInstanceId, Identity,
+                                           WhiskAuthRecord)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.logging import NullLogging
+    from openwhisk_tpu.utils.tracestore import (GLOBAL_TRACE_STORE,
+                                                assemble_trace,
+                                                synthetic_span)
+    from openwhisk_tpu.utils.tracing import GLOBAL_TRACER, trace_id_of
+    from openwhisk_tpu.utils.transaction import TransactionId
+    from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL, N_STAGES
+
+    store = GLOBAL_TRACE_STORE
+    CTL_PORT, PEER_PORT = 13981, 13982
+
+    async def go() -> dict:
+        was_enabled, was_cfg = store.enabled, store.config
+        was_floor = store._floor_every
+        wf_was = GLOBAL_WATERFALL.enabled
+        # arm the plane with a floor crisp enough to assert exactly
+        store.enabled = True
+        store.config = dataclasses.replace(store.config, keep_floor=0.05,
+                                           keep_ring=1024)
+        store._floor_every = 20
+        store.reset()
+        store.attach()
+        GLOBAL_WATERFALL.enabled = True
+        GLOBAL_WATERFALL.reset()
+
+        provider = MemoryMessagingProvider()
+        ring = PartitionRing(8)
+        b0 = TpuBalancer(provider, ControllerInstanceId("0"),
+                         managed_fraction=1.0, blackbox_fraction=0.0,
+                         kernel="xla")
+        b1 = TpuBalancer(provider, ControllerInstanceId("1"),
+                         managed_fraction=1.0, blackbox_fraction=0.0,
+                         kernel="xla")
+        for b in (b0, b1):
+            b.set_partition_mode(ring)
+            await b.start()
+        for pid in range(8):
+            b0.set_partition_leadership(pid, 2, True)
+            b1.partition_epochs[pid] = 2  # peer knowledge, not ownership
+
+        # invoker-side spans: the echo stand-in emits one per message
+        # (the real InvokerReactive's container span pair rides the same
+        # store.active gate)
+        def invoker_spans(instance, msgs):
+            if not store.active:
+                return
+            now = time.time()
+            for m in msgs:
+                tid = trace_id_of(getattr(m, "trace_context", None))
+                if tid:
+                    store.emit(synthetic_span(
+                        tid, "invoker_run", now, now,
+                        tags={"proc": f"invoker{instance.instance}"}))
+
+        feeds, stop_fleet = await _echo_fleet(provider, n_invokers,
+                                              on_frame=invoker_spans)
+        for bal in (b0, b1):
+            for _ in range(120):
+                health = await bal.invoker_health()
+                if sum(h.status == HEALTHY for h in health) >= n_invokers:
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise RuntimeError("trace assembly rider: fleet unhealthy")
+
+        hot_action = _bench_action("ta_hot", memory=128)
+
+        class _Membership:
+            instance = ControllerInstanceId("0")
+
+            @staticmethod
+            def least_loaded_peer():
+                return 1
+
+        class _Store:
+            @staticmethod
+            async def get_action(name, rev=None):
+                class Doc:
+                    @staticmethod
+                    def to_executable():
+                        return hot_action
+
+                return Doc()
+
+        b0.spillover_sink = SpilloverSender(provider, _Membership())
+        receiver = SpilloverReceiver(provider, ControllerInstanceId("1"),
+                                     b1, _Store())
+        receiver.start()
+
+        actions = [_bench_action(f"ta{i}", memory=128) for i in range(4)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(24)
+
+        async def one(action):
+            # the invoke.py driver shape: controller span -> trace
+            # context on the message -> waterfall adoption. Everything
+            # opens INSIDE the semaphore: a context anchored at burst
+            # submit would fold the whole gather's queue wait into the
+            # row total and drag the live p99 to the leg duration.
+            async with sem:
+                transid = TransactionId()
+                span = GLOBAL_TRACER.start_span("controller_activation",
+                                                transid)
+                msg = ActivationMessage(
+                    transid, action.fully_qualified_name, action.rev.rev,
+                    ident, ActivationId.generate(),
+                    ControllerInstanceId("0"), True, {},
+                    trace_context=GLOBAL_TRACER.get_trace_context(transid))
+                tid = trace_id_of(msg.trace_context)
+                GLOBAL_WATERFALL.adopt(msg.activation_id.asString,
+                                       GLOBAL_WATERFALL.open(),
+                                       trace_id=tid)
+                promise = await b0.publish(action, msg)
+                GLOBAL_TRACER.finish_span(
+                    transid, {"activationId": msg.activation_id.asString,
+                              "proc": "controller0"}, span=span)
+                await promise
+            return tid
+
+        async def settle(target):
+            for _ in range(300):
+                if store.stats()["seen"] >= target:
+                    return
+                await asyncio.sleep(0.05)
+
+        out = {}
+        try:
+            # warmup: the first batches pay kernel compile (seconds) —
+            # folded into the live histogram they'd drag the p99 bucket
+            # above the straggler salvo. Drive a burst, then zero both
+            # planes so the measured legs see steady-state latencies only.
+            await asyncio.gather(*[one(actions[i % 4]) for i in range(64)])
+            GLOBAL_WATERFALL.reset()
+            store.reset()
+            # exemplars pinned during warmup reference traces the reset
+            # just purged — drop the phase aggregates with them, so the
+            # every-rendered-exemplar-resolves gate only sees pins made
+            # after the store went clean
+            for bal in (b0, b1):
+                with bal.profiler._phase_lock:
+                    bal.profiler._phases.clear()
+
+            # -- leg 1: the clean bulk keeps at the floor exactly ---------
+            clean_tids = await asyncio.gather(
+                *[one(actions[i % 4]) for i in range(clean)])
+            await settle(clean)
+            floor_kept = [t for t in clean_tids
+                          if (store.get(t) or {}).get("reason") == "floor"]
+            expected = clean // 20
+            assert expected // 2 <= len(floor_kept) <= expected + 1, \
+                f"floor keeps {len(floor_kept)} vs expected ~{expected}"
+
+            # -- leg 2: stragglers keep 100% with reason `slow` -----------
+            # the live threshold is whatever the clean leg's p99 bucket
+            # settled at (XLA recompiles for fresh batch geometries can
+            # legitimately push it to ~1s): the salvo's injected delay
+            # scales to sit clearly above it, like a real straggler does
+            threshold = store.tail_threshold_ms()
+            assert threshold < 2500.0, \
+                f"tail threshold {threshold}ms never settled"
+            delay_s = min(3.0, threshold / 1000.0 * 1.5 + 0.1)
+            for f in feeds:
+                f.delay = delay_s
+            straggler_tids = await asyncio.gather(
+                *[one(actions[0]) for _ in range(stragglers_n)])
+            for f in feeds:
+                f.delay = 0.0
+            await settle(clean + stragglers_n)
+            slow_kept = [t for t in straggler_tids
+                         if "slow" in (store.get(t) or {}).get("reasons",
+                                                               ())]
+            straggler_keep_pct = 100.0 * len(slow_kept) / stragglers_n
+            assert straggler_keep_pct == 100.0, \
+                f"straggler keep {straggler_keep_pct}%"
+
+            # -- leg 3: spillover -> >= 3-process assembled tree ----------
+            i = 0
+            while ring.partition_of(f"sp{i}") != 4:
+                i += 1
+            spill_ident = Identity.generate(f"sp{i}")
+            depth_was = b0.spillover_depth
+            b0.spillover_depth = 2
+            pairs, spill_tids = [], []
+            for _ in range(8):
+                transid = TransactionId()
+                span = GLOBAL_TRACER.start_span("controller_activation",
+                                                transid)
+                msg = ActivationMessage(
+                    transid, hot_action.fully_qualified_name,
+                    hot_action.rev.rev, spill_ident,
+                    ActivationId.generate(), ControllerInstanceId("0"),
+                    False, {},
+                    trace_context=GLOBAL_TRACER.get_trace_context(transid))
+                GLOBAL_WATERFALL.adopt(
+                    msg.activation_id.asString, GLOBAL_WATERFALL.open(),
+                    trace_id=trace_id_of(msg.trace_context))
+                GLOBAL_TRACER.finish_span(
+                    transid, {"activationId": msg.activation_id.asString,
+                              "proc": "controller0"}, span=span)
+                spill_tids.append(trace_id_of(msg.trace_context))
+                pairs.append((hot_action, msg))
+            outs = b0.publish_many(pairs)
+            await asyncio.gather(*outs)
+            b0.spillover_depth = depth_was
+
+            # both halves of a spilled trace land in the SAME ring here
+            # (one process, one global store) — scan entries() for them,
+            # the way two processes' /admin/trace/local answers would
+            def halves_of():
+                by_tid = {}
+                for e in store.entries():
+                    by_tid.setdefault(e.get("trace_id"), []).append(e)
+                return by_tid
+
+            kept_spilled = []
+            for _ in range(300):
+                by_tid = halves_of()
+                kept_spilled = [
+                    t for t in spill_tids
+                    if any("spilled" in e["reasons"]
+                           for e in by_tid.get(t, ()))]
+                if b0.spilled_rows and len(kept_spilled) >= b0.spilled_rows:
+                    break
+                await asyncio.sleep(0.05)
+            assert b0.spilled_rows >= 1, "no rows spilled past the depth"
+            assert len(kept_spilled) >= b0.spilled_rows, \
+                f"{b0.spilled_rows} spilled, {len(kept_spilled)} kept"
+
+            await asyncio.sleep(0.5)  # let the peer halves complete too
+            by_tid = halves_of()
+            assembled, stage_sum, wf_total = None, None, None
+            for t in kept_spilled:
+                halves = by_tid.get(t, [])
+                rows = [e["waterfall"] for e in halves
+                        if e.get("waterfall")]
+                if not rows:
+                    continue
+                a = assemble_trace(t, halves)
+                if len(a["processes"]) < 3 or len(halves) < 2:
+                    continue
+                # telescoping: each half's present deltas sum back to its
+                # own measured total (each delta floors to µs
+                # independently, so the bound is one µs per stage)
+                ok = all(abs(sum(d for d in r["deltas_us"] if d >= 0)
+                             - r["total_us"]) <= N_STAGES for r in rows)
+                assert ok, f"stage deltas do not telescope for {t}"
+                wf_total = max(r["total_us"] for r in rows)
+                stage_sum = sum(d for r in rows
+                                for d in r["deltas_us"] if d >= 0)
+                assembled = a
+                break
+            assert assembled is not None, \
+                "no spilled trace assembled to >= 3 processes (2 halves)"
+
+            # -- leg 4a: every rendered OM exemplar resolves --------------
+            ex_tids = set()
+            for bal in (b0, b1):
+                text = bal.profiler.prometheus_text(openmetrics=True)
+                ex_tids.update(re.findall(r'trace_id="([0-9a-f]+)"', text))
+            if b0.profiler.enabled:
+                assert ex_tids, "profiler on but no exemplars rendered"
+            unresolved = [t for t in ex_tids if store.get(t) is None]
+            assert not unresolved, \
+                f"{len(unresolved)} rendered exemplars not kept"
+
+            # -- leg 4b: dead-peer assembly over real HTTP ----------------
+            async def noop_factory(invoker_id, prov):
+                class _S:
+                    async def stop(self):
+                        pass
+
+                return _S()
+
+            logger = NullLogging()
+            cprov = MemoryMessagingProvider()
+            lb = LeanBalancer(cprov, ControllerInstanceId("0"),
+                              noop_factory, logger=logger,
+                              metrics=logger.metrics, user_memory=MB(512))
+            ctl = Controller(ControllerInstanceId("0"), cprov,
+                             logger=logger, load_balancer=lb)
+            admin = Identity.generate("guest")
+            await ctl.auth_store.put(WhiskAuthRecord(
+                admin.subject, [admin.namespace], [admin.authkey]))
+
+            async def peer_local(request):
+                # a live peer that never kept the trace: found=false,
+                # which must NOT read as a missing member
+                return aioweb.json_response(
+                    {"trace_id": request.match_info["trace_id"],
+                     "found": False, "entry": None})
+
+            papp = aioweb.Application()
+            papp.router.add_get("/admin/trace/local/{trace_id}",
+                                peer_local)
+            prunner = aioweb.AppRunner(papp)
+            await prunner.setup()
+            await aioweb.TCPSite(prunner, "127.0.0.1", PEER_PORT).start()
+
+            class _FleetStub:
+                def peer_directory(self):
+                    return {1: f"http://127.0.0.1:{PEER_PORT}",
+                            2: "http://127.0.0.1:9"}  # dead peer
+
+                async def stop(self):
+                    pass
+
+            await ctl.start(port=CTL_PORT)
+            ctl.membership = _FleetStub()
+            hdrs = {"Authorization": "Basic " + base64.b64encode(
+                admin.authkey.compact.encode()).decode()}
+            target = assembled["trace_id"]
+            try:
+                base = f"http://127.0.0.1:{CTL_PORT}"
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"{base}/admin/trace/{target}",
+                                     headers=hdrs) as r:
+                        http_status = r.status
+                        http_body = await r.json()
+                    async with s.get(f"{base}/admin/traces?reason=slow",
+                                     headers=hdrs) as r:
+                        list_status = r.status
+                        list_body = await r.json()
+            finally:
+                await prunner.cleanup()
+                await ctl.stop()
+            assert http_status == 200, f"assembly answered {http_status}"
+            assert http_body["found"] is True
+            assert http_body["members_missing"] == [2], \
+                f"members_missing {http_body.get('members_missing')}"
+            assert list_status == 200 and len(list_body["traces"]) \
+                >= stragglers_n
+
+            stats = store.stats()
+            out = {
+                "clean": clean,
+                "keep_floor": 0.05,
+                "floor_kept": len(floor_kept),
+                "floor_expected": expected,
+                "tail_threshold_ms": round(threshold, 3),
+                "straggler_delay_s": round(delay_s, 3),
+                "straggler_keep_pct": round(straggler_keep_pct, 1),
+                "spilled_rows": int(b0.spilled_rows),
+                "spilled_kept": len(kept_spilled),
+                "assembled_processes": assembled["processes"],
+                "stage_sum_us": int(stage_sum),
+                "waterfall_total_us": int(wf_total),
+                "dead_peer_status": http_status,
+                "members_missing": http_body["members_missing"],
+                "exemplars_rendered": len(ex_tids),
+                "exemplars_resolved": True,
+                "kept_total": stats["kept_total"],
+                "dropped_total": stats["dropped_total"],
+            }
+        finally:
+            await stop_fleet()
+            await receiver.stop()
+            await b0.close()
+            await b1.close()
+            for f in feeds:
+                await f.stop()
+            store.detach()
+            store.enabled = was_enabled
+            store.config = was_cfg
+            store._floor_every = was_floor
+            store.reset()
+            GLOBAL_WATERFALL.enabled = wf_was
+            GLOBAL_WATERFALL.reset()
+        return out
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# trace_assembly failed: {e!r}", file=sys.stderr)
+        return None
+
+
+def _trace_plane_overhead(repeats: int = 20, total: int = 2000,
+                          concurrency: int = 64) -> Optional[dict]:
+    """ISSUE 18 gate: the armed trace observatory's marginal cost on the
+    traced blocking-publish path, <= 5% by acceptance. Same paired-segment
+    protocol as `_fleet_observatory_overhead` (fixture built ONCE,
+    armed/disarmed segments back-to-back, order flipped per repeat,
+    20%-trimmed mean over the pairs): the driver makes real spans + trace
+    contexts + waterfall adoptions in BOTH arms (that cost is the tracing
+    spine's, paid since PR 2), so the pair isolates exactly what this PR
+    added — the reporter tee, the completion-time verdict, and the floor
+    keeps' serialization."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.tracestore import GLOBAL_TRACE_STORE
+    from openwhisk_tpu.utils.tracing import GLOBAL_TRACER, trace_id_of
+    from openwhisk_tpu.utils.transaction import TransactionId
+    from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL
+
+    store = GLOBAL_TRACE_STORE
+
+    async def go() -> dict:
+        was_enabled = store.enabled
+        wf_was = GLOBAL_WATERFALL.enabled
+        GLOBAL_WATERFALL.enabled = True
+        GLOBAL_WATERFALL.reset()
+        store.enabled = True
+        store.reset()
+        store.attach()
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          kernel="xla")
+        await bal.start()
+        feeds, stop_fleet = await _echo_fleet(provider, 16)
+        from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= 16:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("trace plane rider: fleet unhealthy")
+
+        actions = [_bench_action(f"tp{i}", memory=128) for i in range(8)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            action = actions[i % len(actions)]
+            transid = TransactionId()
+            span = GLOBAL_TRACER.start_span("controller_activation",
+                                            transid)
+            msg = ActivationMessage(
+                transid, action.fully_qualified_name, action.rev.rev,
+                ident, ActivationId.generate(), ControllerInstanceId("0"),
+                True, {},
+                trace_context=GLOBAL_TRACER.get_trace_context(transid))
+            GLOBAL_WATERFALL.adopt(msg.activation_id.asString,
+                                   GLOBAL_WATERFALL.open(),
+                                   trace_id=trace_id_of(msg.trace_context))
+            async with sem:
+                promise = await bal.publish(action, msg)
+                GLOBAL_TRACER.finish_span(
+                    transid, {"proc": "controller0"}, span=span)
+                await promise
+
+        async def segment() -> float:
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one(i) for i in range(total)])
+            return total / (time.perf_counter() - t0)
+
+        try:
+            await segment()  # warmup: compile + settle
+            pairs = []
+            on_rates, off_rates = [], []
+            for k in range(repeats):
+                order = (True, False) if k % 2 == 0 else (False, True)
+                rate = {}
+                for armed in order:
+                    if armed:
+                        store.enabled = True
+                        store.reset()
+                        store.attach()
+                    else:
+                        store.detach()
+                        store.enabled = False
+                    rate[armed] = await segment()
+                on_rates.append(rate[True])
+                off_rates.append(rate[False])
+                pairs.append(100.0 * (rate[False] - rate[True])
+                             / rate[False])
+        finally:
+            await stop_fleet()
+            await bal.close()
+            for f in feeds:
+                await f.stop()
+            store.detach()
+            store.enabled = was_enabled
+            store.reset()
+            GLOBAL_WATERFALL.enabled = wf_was
+            GLOBAL_WATERFALL.reset()
+        trim = max(1, len(pairs) // 5)
+        kept = sorted(pairs)[trim:-trim] if len(pairs) > 2 * trim else pairs
+        return {
+            "rate_trace_plane_on": round(max(on_rates), 1),
+            "rate_trace_plane_off": round(max(off_rates), 1),
+            "overhead_pct": round(statistics.mean(kept), 2),
+            "target_pct": 5.0,
+            "pair_overheads_pct": [round(p, 2) for p in pairs],
+            "repeats": repeats,
+            "agg": "trimmed_mean_paired_segments",
+        }
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# trace_plane_overhead failed: {e!r}", file=sys.stderr)
         return None
 
 
@@ -2799,6 +3346,8 @@ def _run(args) -> Optional[dict]:
     failover_downtime = None
     partition_chaos = None
     sharded_fleet_sweep = None
+    trace_assembly = None
+    trace_plane_overhead = None
     if not args.quick:
         # the new headline first: the open-loop observatory (sustained
         # activations/s + the per-stage budget the next PR attacks)
@@ -2831,6 +3380,13 @@ def _run(args) -> Optional[dict]:
                                         _placement_quality)
         placement_quality_overhead = timed_rider(
             "_placement_quality_overhead", _placement_quality_overhead)
+        # ISSUE 18: the tail-sampled trace observatory — the acceptance
+        # legs (floor-exact clean keep, 100% straggler keep, >= 3-process
+        # assembly, dead-peer degradation, exemplar resolution) and the
+        # paired <= 5% overhead gate on the traced publish path
+        trace_assembly = timed_rider("_trace_assembly", _trace_assembly)
+        trace_plane_overhead = timed_rider("_trace_plane_overhead",
+                                           _trace_plane_overhead)
         repair_vs_scan = timed_rider("_repair_vs_scan", _repair_vs_scan)
         # ROADMAP item 2: placement rate per fleet size over the
         # ('fleet',) mesh (the MULTICHIP dryrun folded into the bench)
@@ -2972,6 +3528,10 @@ def _run(args) -> Optional[dict]:
         out["sharded_fleet_sweep"] = sharded_fleet_sweep
     if pipeline_speedup is not None:
         out["pipeline_speedup"] = pipeline_speedup
+    if trace_assembly is not None:
+        out["trace_assembly"] = trace_assembly
+    if trace_plane_overhead is not None:
+        out["trace_plane_overhead"] = trace_plane_overhead
     if any(isinstance(r, dict) and r.get("backend") == "cpu_fallback"
            for r in (recorder_overhead, telemetry_overhead,
                      profiling_overhead, anomaly_overhead,
@@ -2980,6 +3540,7 @@ def _run(args) -> Optional[dict]:
                      repair_vs_scan, pipeline_speedup,
                      bus_coalesce_speedup, failover_downtime,
                      partition_chaos, sharded_fleet_sweep,
+                     trace_assembly, trace_plane_overhead,
                      host_profiling_overhead, host_observatory)):
         # a rider lost the device mid-run and re-ran on CPU: say so at the
         # top level so trajectory readers never mistake a CPU number for a

@@ -158,6 +158,14 @@ class ControllerApi:
         r.add_get("/admin/fleet/host", self.fleet_host)
         r.add_get("/admin/fleet/quality", self.fleet_quality)
         r.add_get("/admin/fleet/timeline", self.fleet_timeline)
+        # trace observatory (ISSUE 18): the tail-sampled kept-trace read
+        # side. `local` (a peer-scrape leaf) must register before the
+        # assembling route — aiohttp matches in registration order.
+        # Auth-gated; every handler 404s while
+        # CONFIG_whisk_tracing_tail_enabled=false.
+        r.add_get("/admin/traces", self.traces_list)
+        r.add_get("/admin/trace/local/{trace_id}", self.trace_local)
+        r.add_get("/admin/trace/{trace_id}", self.trace_assembled)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -894,6 +902,76 @@ class ControllerApi:
         body = merged_timeline(events, limit=limit)
         body["evicted"] = GLOBAL_EVENT_LOG.evicted
         return web.json_response(body)
+
+    # ------------------------------------------------- trace observatory
+    def _trace_store(self):
+        from ..utils.tracestore import GLOBAL_TRACE_STORE
+        return GLOBAL_TRACE_STORE if GLOBAL_TRACE_STORE.enabled else None
+
+    def _trace_disabled(self, request):
+        return _error(404, "the trace observatory is disabled "
+                      "(CONFIG_whisk_tracing_tail_enabled=false)",
+                      request.get("transid"))
+
+    async def traces_list(self, request):
+        """Kept-trace summaries, newest first: `?reason=` filters by
+        verdict reason (error/timeout/fenced/spilled/forced/divergent/
+        exemplar/slow/floor), `?n=` caps the page. The `stats` block
+        carries the keep/drop/pending counters and the live tail
+        threshold."""
+        store = self._trace_store()
+        if store is None:
+            return self._trace_disabled(request)
+        try:
+            n = max(1, int(request.query.get("n", 50)))
+        except ValueError:
+            return _error(400, "n must be an integer",
+                          request.get("transid"))
+        reason = request.query.get("reason") or None
+        return web.json_response({"traces": store.list(reason=reason, n=n),
+                                  "stats": store.stats()})
+
+    async def trace_local(self, request):
+        """This process's kept half of one trace — the leaf the
+        assembling route scrapes from every peer. Unknown trace ids
+        answer 200 `{"found": false}` (a live peer that never kept the
+        trace is NOT a missing member); only a disabled plane 404s."""
+        store = self._trace_store()
+        if store is None:
+            return self._trace_disabled(request)
+        tid = request.match_info["trace_id"]
+        entry = store.get(tid)
+        return web.json_response({"trace_id": tid,
+                                  "found": entry is not None,
+                                  "entry": entry})
+
+    async def trace_assembled(self, request):
+        """ONE causal span tree for a trace id, assembled from every
+        process that kept a half: the local store plus the live peer
+        directory's `/admin/trace/local/{id}` leaves, clock-aligned at
+        the bus handoff pairs and telescoping to the measured e2e.
+        Per-peer failures degrade to `members_missing` — this endpoint
+        answers 200 with whatever halves arrived, never a 500."""
+        store = self._trace_store()
+        if store is None:
+            return self._trace_disabled(request)
+        from ..utils.tracestore import assemble_trace
+        tid = request.match_info["trace_id"]
+        halves = []
+        local = store.get(tid)
+        if local is not None:
+            halves.append(local)
+        missing = []
+        cfg = self._fleet_cfg()
+        if cfg is not None:
+            peers, missing = await self._fleet_scrape(
+                request, cfg, f"/admin/trace/local/{tid}")
+            for k in sorted(peers):
+                body = peers[k] or {}
+                if body.get("found") and body.get("entry"):
+                    halves.append(body["entry"])
+        return web.json_response(
+            assemble_trace(tid, halves, members_missing=missing))
 
     async def placement_occupancy(self, request):
         """Per-invoker slots-in-use/capacity derived from the balancer

@@ -136,7 +136,8 @@ class ActionInvoker:
         finally:
             GLOBAL_TRACER.finish_span(
                 transid, {"action": str(action.fully_qualified_name),
-                          "activationId": msg.activation_id.asString},
+                          "activationId": msg.activation_id.asString,
+                          "proc": f"controller{self.controller.name}"},
                 span=span)
 
     async def _wait_for_response(self, identity: Identity, msg: ActivationMessage,

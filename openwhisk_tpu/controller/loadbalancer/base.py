@@ -31,6 +31,7 @@ from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
 from ...utils.config import load_config
 from ...utils.eventlog import GLOBAL_EVENT_LOG
 from ...utils.logging import MetricEmitter
+from ...utils.tracestore import GLOBAL_TRACE_STORE
 from ...utils.tracing import trace_id_of
 from ...utils.transaction import TransactionId
 from ...utils.waterfall import (GLOBAL_WATERFALL, STAGE_COMPLETION_ACK,
@@ -298,6 +299,25 @@ class CommonLoadBalancer(LoadBalancer):
                             invoker_names=self._telemetry_invoker_names)
         self._quality_renderer = self._quality_exposition
         self.metrics.register_renderer(self._quality_renderer)
+        # the tail-sampled trace observatory (ISSUE 18, same hook pattern,
+        # PROCESS-WIDE like the waterfall: spans report from layers that
+        # never see a balancer — this hook attaches the reporter tee,
+        # wires the completion verdict's live threshold + placement join,
+        # and owns the trace_kept/dropped exposition). Disabled config
+        # means NOTHING here runs: no tee, no renderer, no attribute but
+        # the store reference itself.
+        self.trace_store = GLOBAL_TRACE_STORE
+        self._trace_renderer = None
+        if self.trace_store.enabled:
+            self.trace_store.attach()
+            wf_threshold = getattr(self.waterfall, "tail_threshold_ms", None)
+            if wf_threshold is not None:
+                self.trace_store.threshold_source = wf_threshold
+            self.trace_store.default_threshold_ms = \
+                float(self.telemetry.slo.e2e_p99_ms)
+            self.trace_store.placement_lookup = self._trace_placement_lookup
+            self._trace_renderer = self.trace_store.prometheus_text
+            self.metrics.register_renderer(self._trace_renderer)
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -492,7 +512,13 @@ class CommonLoadBalancer(LoadBalancer):
             return None
         if (msg.fence_part == pid and msg.fence_epoch is not None
                 and msg.fence_epoch >= self.partition_epochs.get(pid, 0)):
-            return None  # current-epoch spillover from the owner
+            # current-epoch spillover from the owner: a fenced handoff
+            # row is always trace-worthy (ISSUE 18) — note it before the
+            # verdict. Rare path (spilled-in rows only), one dict op.
+            if self.trace_store.active:
+                self.trace_store.mark(trace_id_of(msg.trace_context),
+                                      "fenced")
+            return None
         return LoadBalancerException(
             f"partition {pid} is owned by another controller")
 
@@ -629,11 +655,17 @@ class CommonLoadBalancer(LoadBalancer):
         now_mono = time.monotonic()
         tp = self.telemetry
         finish_aids: List[str] = []
+        # (aid, trace_id, e2e_ms, is_error) per released slot, consumed by
+        # the trace store's completion verdict after the waterfall fold
+        # hands back the computed rows (ISSUE 18). None = plane off: the
+        # whole leg is one attribute check.
+        trace_done: Optional[List[tuple]] = \
+            [] if self.trace_store.enabled else None
         regular = 0
         for ack in acks:
             try:
                 regular += self._process_ack_batched(
-                    ack, now_ns, now_mono, tp, wf, finish_aids)
+                    ack, now_ns, now_mono, tp, wf, finish_aids, trace_done)
             except Exception as e:  # noqa: BLE001 — per-ack isolation (the
                 # serial frames isolated failures per feed hand-off)
                 if self.logger:
@@ -643,13 +675,26 @@ class CommonLoadBalancer(LoadBalancer):
             self.metrics.counter("loadbalancer_completion_ack_regular",
                                  regular)
         if finish_aids:
-            wf.finish_many(finish_aids)
+            if trace_done is not None:
+                rows: List[dict] = []
+                wf.finish_many(finish_aids, rows_out=rows)
+                rowmap = {r["activation_id"]: r for r in rows}
+            else:
+                wf.finish_many(finish_aids)
+        elif trace_done is not None:
+            rowmap = {}
+        if trace_done:
+            store = self.trace_store
+            for aid_s, tid, e2e_ms, err in trace_done:
+                store.complete(aid_s, tid, e2e_ms, error=err,
+                               row=rowmap.get(aid_s))
         if tp.enabled:
             tp.maybe_tick(self.metrics)
             self.anomaly.maybe_tick(self.metrics)
 
     def _process_ack_batched(self, ack, now_ns: int, now_mono: float,
-                             tp, wf, finish_aids: List[str]) -> int:
+                             tp, wf, finish_aids: List[str],
+                             trace_done: Optional[List[tuple]] = None) -> int:
         """One ack's share of the batched pass; returns 1 when it released
         a tracked (regular) slot, 0 otherwise."""
         if ack.activation is not None:
@@ -692,6 +737,17 @@ class CommonLoadBalancer(LoadBalancer):
             else:
                 wf.stamp(aid.asString, STAGE_COMPLETION_ACK, now_ns)
             finish_aids.append(aid.asString)
+        if trace_done is not None:
+            # the verdict inputs are all already in hand — trace id off
+            # the ack (the invoker's active-ack rider), e2e off the
+            # telemetry observation's clock read: no new clock, no I/O
+            tc = getattr(ack, "trace_context", None)
+            trace_done.append((
+                aid.asString,
+                trace_id_of(tc) if tc else None,
+                ((now_mono - entry.t_start) * 1e3
+                 if entry.t_start > 0.0 else None),
+                bool(ack.is_system_error)))
         self.on_invocation_finished(inv,
                                     is_system_error=ack.is_system_error,
                                     forced=False)
@@ -729,12 +785,23 @@ class CommonLoadBalancer(LoadBalancer):
             # entry carries the vector (the t_start generalization), so
             # the stamp goes straight onto it; finish still pops by id.
             wf = self.waterfall
+            row = None
             if wf.enabled:
                 if entry.stages is not None:
                     wf.stamp_ctx(entry.stages, STAGE_COMPLETION_ACK)
                 else:
                     wf.stamp(aid.asString, STAGE_COMPLETION_ACK)
-                wf.finish(aid.asString)
+                row = wf.finish(aid.asString)
+            if self.trace_store.enabled:
+                # serial-path verdict (ISSUE 18): forced completions are
+                # the controller-side timeout — exactly the traces tail
+                # sampling exists to keep
+                e2e_ms = ((time.monotonic() - entry.t_start) * 1e3
+                          if entry.t_start > 0.0 else None)
+                self.trace_store.complete(
+                    aid.asString,
+                    row.get("trace_id") if row else None,
+                    e2e_ms, error=is_system_error, timeout=forced, row=row)
             self.on_invocation_finished(invoker or (entry.invoker if entry else None),
                                         is_system_error=is_system_error,
                                         forced=forced)
@@ -831,6 +898,25 @@ class CommonLoadBalancer(LoadBalancer):
         return self.quality.prometheus_text(
             self._telemetry_invoker_names(), openmetrics=openmetrics)
 
+    def _trace_placement_lookup(self, activation_id: str) -> Optional[dict]:
+        """The trace store's keep-time join (ISSUE 18): the flight
+        recorder's placement batch for a KEPT activation — the same shape
+        the latency-waterfall slowest-row join ships, plus the quality
+        digest. Called only on the keep path, never per completion."""
+        found = self.flight_recorder.explain(activation_id)
+        if found is None:
+            return None
+        batch = found["batch"]
+        return {
+            "seq": batch["seq"],
+            "kernel": batch["digest"].get("kernel"),
+            "queue_depth": batch["digest"].get("queue_depth"),
+            "trace_id": batch["digest"].get("trace_id"),
+            "timings": batch.get("timings", {}),
+            "quality": batch["digest"].get("quality"),
+            "decision": found.get("decision"),
+        }
+
     # -- kernel profiling plane (shared hook, like the flight recorder) ----
     def kernel_profile(self) -> dict:
         """The `GET /admin/profile/kernel` payload. CPU balancers report a
@@ -864,6 +950,8 @@ class CommonLoadBalancer(LoadBalancer):
         self.metrics.unregister_renderer(self._anomaly_renderer)
         self.metrics.unregister_renderer(self._waterfall_renderer)
         self.metrics.unregister_renderer(self._quality_renderer)
+        if self._trace_renderer is not None:
+            self.metrics.unregister_renderer(self._trace_renderer)
 
 
 def _bridge_publish_future(row: asyncio.Future, waiter: asyncio.Future) -> None:

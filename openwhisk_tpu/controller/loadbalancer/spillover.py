@@ -91,6 +91,7 @@ class SpilloverSender:
             self.metrics.counter("loadbalancer_spillover_batches")
         GLOBAL_EVENT_LOG.record("spill_burst", peer=int(peer),
                                 rows=len(msgs))
+        self._emit_hop_spans(msgs, peer)
 
         async def _send() -> None:
             try:
@@ -107,6 +108,30 @@ class SpilloverSender:
 
         asyncio.get_event_loop().create_task(_send())
         return outs
+
+    def _emit_hop_spans(self, msgs, peer) -> None:
+        """ISSUE 18: stamp the spill hop into the trace observatory — one
+        zero-width `spill_forward` span per forwarded row, so an assembled
+        cross-process trace shows the extra controller the row visited.
+        One clock read per burst (amortized over the batch; the event-log
+        record above already paid one), nothing when the plane is off."""
+        from ...utils.tracestore import GLOBAL_TRACE_STORE, synthetic_span
+        from ...utils.tracing import trace_id_of
+        if not GLOBAL_TRACE_STORE.active:
+            return
+        import time
+        ts = time.time()
+        inst = getattr(getattr(self.membership, "instance", None),
+                       "instance", None)
+        proc = f"controller{inst}" if inst is not None else "controller?"
+        for msg in msgs:
+            tid = trace_id_of(getattr(msg, "trace_context", None))
+            if tid is None:
+                continue
+            GLOBAL_TRACE_STORE.mark(tid, "spilled")
+            GLOBAL_TRACE_STORE.emit(synthetic_span(
+                tid, "spill_forward", ts, ts,
+                tags={"proc": proc, "peer": str(int(peer))}))
 
 
 class SpilloverReceiver:
@@ -182,6 +207,18 @@ class SpilloverReceiver:
                                      "Spillover")
         if not pairs:
             return
+        # ISSUE 18: open the peer-side waterfall half. The origin folded
+        # its stage vector at spill_forward — this process owns the rest
+        # of the row's life, so its stages (publish_enqueue onward) need
+        # a fresh ctx carrying the same trace id; the assembler pins this
+        # half's publish_enqueue to the origin's spill_forward stamp.
+        wf = getattr(self.balancer, "waterfall", None)
+        if wf is not None and wf.enabled:
+            from ...utils.tracing import trace_id_of
+            for _executable, msg in pairs:
+                wf.adopt(msg.activation_id.asString, wf.open(),
+                         trace_id=trace_id_of(
+                             getattr(msg, "trace_context", None)))
         self.received += len(pairs)
         if self.metrics is not None:
             self.metrics.counter("loadbalancer_spillover_received",

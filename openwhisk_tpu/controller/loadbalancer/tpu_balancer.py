@@ -2037,8 +2037,20 @@ class TpuBalancer(CommonLoadBalancer):
         # origin-side waterfall (an async send that later fails shows up
         # in loadbalancer_spillover_send_failed, like a lost produce)
         for _action, msg, _out, _pid in rows:
-            wf.stamp(msg.activation_id.asString, STAGE_SPILL_FORWARD)
-            wf.finish(msg.activation_id.asString)
+            aid = msg.activation_id.asString
+            wf.stamp(aid, STAGE_SPILL_FORWARD)
+            row = wf.finish(aid)
+            # ISSUE 18: the origin half's tail verdict runs HERE — the
+            # spill hop is this process's terminal stage (no completion
+            # ack ever comes back to these books), so waiting for one
+            # would leak the pending spans forever. The kept half (the
+            # driver + hop spans joined to the partial stage vector) is
+            # what /admin/trace/{id} merges with the peer's half.
+            if self.trace_store.enabled:
+                from ...utils.tracing import trace_id_of
+                tid = (row or {}).get("trace_id") or trace_id_of(
+                    getattr(msg, "trace_context", None))
+                self.trace_store.complete(aid, tid, row=row)
         self.spilled_rows += len(rows)
         self.metrics.counter("loadbalancer_spillover_forwarded", len(rows))
         for (_action, _msg, out, _pid), row_sent in zip(rows, sent):
@@ -3317,6 +3329,9 @@ class TpuBalancer(CommonLoadBalancer):
             # everything); skipped batches still refresh the gauges
             self._record_batch(rec, batch, chosen_np, forced_np, throttled_np,
                                fanout_ms, file=prof.admit_batch(dt_ms))
+            # after the record files: the device span's batch_seq tag is
+            # the assigned ring seq (the join key /admin/trace ships)
+            self._trace_batch_hooks(rec, batch, forced_np, dt_ms, b)
             if prof.capture_armed:
                 row = rec.to_json()
                 row["total_ms"] = round(dt_ms, 3)
@@ -3325,6 +3340,37 @@ class TpuBalancer(CommonLoadBalancer):
             # flight recorder off: the capture window still gets timings
             prof.capture_step({"ts": time.time(), "batch_size": b,
                                "total_ms": round(dt_ms, 3)})
+
+    def _trace_batch_hooks(self, rec, batch, forced_np, dt_ms: float,
+                           b: int) -> None:
+        """ISSUE 18 trace-observatory riders for one placed micro-batch,
+        all from stamps already taken (rec.ts, dt_ms — no new clock
+        reads): the per-batch `device_dispatch` span under the digest's
+        trace id (the flight-recorder link the assembled tree joins on),
+        the `divergent` mark when the shadow counterfactual disagreed,
+        the `exemplar` force-keep (the phase histogram just pinned this
+        trace id onto a bucket line — every rendered exemplar must
+        resolve), and the `forced` mark per force-placed row."""
+        from ...utils.tracestore import GLOBAL_TRACE_STORE, synthetic_span
+        store = GLOBAL_TRACE_STORE
+        if not store.active:
+            return
+        tid = rec.digest.get("trace_id")
+        if tid:
+            store.emit(synthetic_span(
+                tid, "device_dispatch", rec.ts, rec.ts + dt_ms / 1e3,
+                tags={"proc": f"controller{self.controller.name}",
+                      "batch_seq": str(rec.seq),
+                      "kernel": str(rec.digest.get("kernel")),
+                      "batch_size": str(b)}))
+            if self.profiler.enabled:
+                store.force(tid, "exemplar")
+            q = rec.digest.get("quality")
+            if q and q.get("divergent"):
+                store.mark(tid, "divergent")
+        for e, f in zip(batch, forced_np):
+            if f and e[6]:
+                store.mark(e[6], "forced")
 
     def _record_batch(self, rec, batch, chosen_np, forced_np, throttled_np,
                       fanout_ms: float, file: bool = True) -> None:

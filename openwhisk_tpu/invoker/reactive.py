@@ -330,6 +330,14 @@ class InvokerReactive:
         else:
             message = CombinedCompletionAndResultMessage(transid, activation,
                                                          self.instance)
+        # trace continuity (ISSUE 18): the ack carries this activation's
+        # span context back over the bus, so the controller's completion
+        # processing parents into the same trace in BOTH wire formats
+        # (the columnar ack frames ship it as a sparse column)
+        span = self._active_spans.get(activation.activation_id.asString)
+        if span is not None:
+            message.trace_context = {
+                "traceparent": f"00-{span.trace_id}-{span.span_id}-01"}
         await self.producer.send(topic, message.shrink())
         if kind != "result":
             # final ack: publish the user-facing activation event
@@ -360,8 +368,33 @@ class InvokerReactive:
             from ..utils.tracing import GLOBAL_TRACER
             span = self._active_spans.pop(activation.activation_id.asString, None)
             if span is not None:
+                self._emit_container_spans(span, activation)
                 GLOBAL_TRACER.finish(span, {
-                    "activationId": activation.activation_id.asString})
+                    "activationId": activation.activation_id.asString,
+                    "proc": f"invoker{self.instance.instance}"})
+
+    def _emit_container_spans(self, parent, activation) -> None:
+        """The container_acquire/run span pair (ISSUE 18), synthesized
+        from timestamps the activation record ALREADY carries (start/end
+        wall clocks, the waitTime annotation) — no new clock reads, and
+        nothing at all when no tail-sampling trace store collects them."""
+        from ..utils.tracestore import GLOBAL_TRACE_STORE, synthetic_span
+        if not GLOBAL_TRACE_STORE.active:
+            return
+        proc = f"invoker{self.instance.instance}"
+        ann = activation.annotations or {}
+        wait_s = (ann.get("waitTime") or 0) / 1000.0
+        start, end = activation.start, activation.end or activation.start
+        if wait_s > 0:
+            GLOBAL_TRACE_STORE.emit(synthetic_span(
+                parent.trace_id, "container_acquire",
+                start - wait_s, start,
+                tags={"proc": proc}, parent_id=parent.span_id))
+        GLOBAL_TRACE_STORE.emit(synthetic_span(
+            parent.trace_id, "run", start, end,
+            tags={"proc": proc,
+                  "initTime_ms": ann.get("initTime") or 0},
+            parent_id=parent.span_id))
 
     async def _store_activation(self, transid, activation, user) -> None:
         try:

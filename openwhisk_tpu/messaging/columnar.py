@@ -347,14 +347,19 @@ class AckBatchMessage(Message):
     def _columns(self) -> dict:
         """The shared (response-free) ack columns: the eager record and
         the lazy header carry their responses differently, so each
-        caller builds its own resp column."""
+        caller builds its own resp column. The sparse `trace` column
+        (ISSUE 18) mirrors the activation batch's: present only when
+        some ack carries a trace context, so untraced batches stay
+        byte-exact with the PR 11/14 frames — and because it lives HERE
+        it rides both the eager record and the lazy header."""
         invs = _Dedup()
         kinds: List[str] = []
         tx_col: List[object] = []
         ids: List[str] = []
         iv_col: List[int] = []
         err_col: List[int] = []
-        for m in self.msgs:
+        trace: Dict[str, dict] = {}
+        for row, m in enumerate(self.msgs):
             kinds.append(_ACK_CODES.get(m.kind, "b"))
             tx_col.append(m.transid.to_json())
             ids.append(m.activation_id.asString)
@@ -362,8 +367,14 @@ class AckBatchMessage(Message):
                           else invs.intern(m.invoker.as_string,
                                            m.invoker.to_json()))
             err_col.append(1 if m.is_system_error else 0)
-        return {"invs": invs.values, "kinds": "".join(kinds),
-                "tx": tx_col, "ids": ids, "iv": iv_col, "err": err_col}
+            tc = getattr(m, "trace_context", None)
+            if tc is not None:
+                trace[str(row)] = tc
+        out = {"invs": invs.values, "kinds": "".join(kinds),
+               "tx": tx_col, "ids": ids, "iv": iv_col, "err": err_col}
+        if trace:
+            out["trace"] = trace
+        return out
 
     def to_json(self) -> dict:
         out = {"whiskBatch": KIND_ACK}
@@ -405,22 +416,25 @@ class AckBatchMessage(Message):
     def from_json(j: dict) -> List[AcknowledgementMessage]:
         from ..core.entity import InvokerInstanceId, WhiskActivation
         invs = [InvokerInstanceId.from_json(v) for v in j["invs"]]
+        trace = j.get("trace") or {}
         out: List[AcknowledgementMessage] = []
-        for code, tx, aid, iv, err, resp in zip(
+        for row, (code, tx, aid, iv, err, resp) in enumerate(zip(
                 j["kinds"], j["tx"], j["ids"], j["iv"], j["err"],
-                j["resp"]):
+                j["resp"])):
             transid = TransactionId.from_json(tx)
             inv = invs[iv] if iv >= 0 else None
             act = WhiskActivation.from_json(resp) if resp else None
             kind = _ACK_KINDS.get(code, "combined")
             if kind == "completion":
-                out.append(CompletionMessage(transid, ActivationId(aid),
-                                             bool(err), inv))
+                ack = CompletionMessage(transid, ActivationId(aid),
+                                        bool(err), inv)
             elif kind == "result":
-                out.append(ResultMessage(transid, act))
+                ack = ResultMessage(transid, act)
             else:
-                out.append(CombinedCompletionAndResultMessage(transid, act,
-                                                              inv))
+                ack = CombinedCompletionAndResultMessage(transid, act, inv)
+            # set post-construction: the kind ctors are frozen contracts
+            ack.trace_context = trace.get(str(row))
+            out.append(ack)
         return out
 
     @staticmethod
@@ -437,12 +451,13 @@ class AckBatchMessage(Message):
         this frame exists to defer."""
         from ..core.entity import InvokerInstanceId
         invs = [InvokerInstanceId.from_json(v) for v in header["invs"]]
+        trace = header.get("trace") or {}
         lens = header["respLen"]
         out: List[AcknowledgementMessage] = []
         off = 0
-        for code, tx, aid, iv, err, ln in zip(
+        for row, (code, tx, aid, iv, err, ln) in enumerate(zip(
                 header["kinds"], header["tx"], header["ids"], header["iv"],
-                header["err"], lens):
+                header["err"], lens)):
             raw = body[off:off + ln] if ln else b""
             off += ln
             ack = AcknowledgementMessage(
@@ -450,6 +465,7 @@ class AckBatchMessage(Message):
                 invs[iv] if iv >= 0 else None, bool(err),
                 LazyWhiskActivation(raw) if raw else None)
             ack.kind = _ACK_KINDS.get(code, "combined")
+            ack.trace_context = trace.get(str(row))
             out.append(ack)
         if off != len(body):
             raise ValueError(

@@ -128,6 +128,14 @@ class AcknowledgementMessage(Message):
         self.invoker = invoker
         self.is_system_error = is_system_error
         self.activation = activation
+        #: trace continuity across the completion hop (ISSUE 18): the
+        #: invoker's span context rides the ack so the controller's
+        #: completion processing parents correctly — and the tail-sampled
+        #: trace store can join by trace id even when the waterfall is
+        #: off. None (the default) keeps every ack wire byte-exact with
+        #: pre-18 builds; set post-construction (the kind subclasses'
+        #: signatures are frozen wire contracts).
+        self.trace_context: Optional[Dict[str, str]] = None
 
     @property
     def is_slot_free(self) -> bool:
@@ -149,11 +157,12 @@ class AcknowledgementMessage(Message):
                                              self.invoker, self.is_system_error,
                                              copy)
                 out.kind = self.kind
+                out.trace_context = self.trace_context
                 return out
         return self
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "kind": self.kind,
             "transid": self.transid.to_json(),
             "activationId": self.activation_id.to_json(),
@@ -161,6 +170,11 @@ class AcknowledgementMessage(Message):
             "isSystemError": self.is_system_error,
             "response": self.activation.to_json() if self.activation else None,
         }
+        if self.trace_context is not None:
+            # only on the wire when tracing propagates (the PingMessage
+            # absent-when-None pattern keeps untraced acks byte-exact)
+            out["traceContext"] = self.trace_context
+        return out
 
 
 class CompletionMessage(AcknowledgementMessage):
@@ -196,14 +210,17 @@ def parse_ack(raw: Union[bytes, str]) -> AcknowledgementMessage:
     inv = InvokerInstanceId.from_json(j["invoker"]) if j.get("invoker") else None
     act = WhiskActivation.from_json(j["response"]) if j.get("response") else None
     if kind == "completion":
-        return CompletionMessage(transid, aid, bool(j.get("isSystemError")), inv)
-    if kind == "result":
+        ack = CompletionMessage(transid, aid, bool(j.get("isSystemError")), inv)
+    elif kind == "result":
         assert act is not None
-        return ResultMessage(transid, act)
-    if kind == "combined":
+        ack = ResultMessage(transid, act)
+    elif kind == "combined":
         assert act is not None
-        return CombinedCompletionAndResultMessage(transid, act, inv)
-    raise ValueError(f"unknown ack kind {kind!r}")
+        ack = CombinedCompletionAndResultMessage(transid, act, inv)
+    else:
+        raise ValueError(f"unknown ack kind {kind!r}")
+    ack.trace_context = j.get("traceContext")
+    return ack
 
 
 class PingMessage(Message):

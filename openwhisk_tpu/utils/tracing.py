@@ -48,22 +48,24 @@ class Reporter:
 
 
 class BufferReporter(Reporter):
-    """In-memory span sink. A full buffer DROPS new spans — counted (like
-    ZipkinReporter.dropped_spans), so a saturated buffer is visible to
-    tests/operators instead of silently lossy."""
+    """In-memory span sink, ring-shaped: a full buffer evicts the OLDEST
+    span so the NEWEST always survive — on a long soak the buffer tracks
+    live traffic instead of fossilizing at startup spans. Evictions count
+    as `dropped_spans` (like ZipkinReporter), so a saturated buffer stays
+    visible to tests/operators instead of silently lossy."""
 
     def __init__(self, max_spans: int = 10_000):
-        self.spans: List[Span] = []
+        from collections import deque
+        self.spans = deque(maxlen=max(1, max_spans))
         self.max_spans = max_spans
         self.sent_spans = 0
         self.dropped_spans = 0
 
     def report(self, span: Span) -> None:
-        if len(self.spans) < self.max_spans:
-            self.spans.append(span)
-            self.sent_spans += 1
-        else:
+        if len(self.spans) >= self.max_spans:
             self.dropped_spans += 1
+        self.spans.append(span)
+        self.sent_spans += 1
 
 
 class ZipkinReporter(Reporter):
@@ -193,7 +195,14 @@ def maybe_enable_zipkin(service_name: str,
     reporter = ZipkinReporter(cfg.zipkin_url, service_name=service_name,
                               batch_size=cfg.batch_size,
                               flush_interval=cfg.flush_interval)
-    (tracer or GLOBAL_TRACER).reporter = reporter
+    t = tracer or GLOBAL_TRACER
+    current = t.reporter
+    if hasattr(current, "swap_inner"):
+        # a trace-store tee (utils/tracestore.py) wraps the real sink:
+        # swap the sink INSIDE it so the tail-sampling tee survives
+        current.swap_inner(reporter)
+    else:
+        t.reporter = reporter
     return reporter
 
 
@@ -204,6 +213,11 @@ class Tracer:
                  expiry_seconds: float = 3600.0):
         self.reporter = reporter or BufferReporter()
         self.expiry = expiry_seconds
+        #: opportunistic-sweep cadence: a fraction of the expiry so small
+        #: populations of abandoned stacks (below the size trigger) still
+        #: age out within ~1.25x the expiry window
+        self._sweep_interval = max(0.05, expiry_seconds / 4.0)
+        self._last_sweep = time.monotonic()
         self._stacks: Dict[str, List[Span]] = {}
         self._touched: Dict[str, float] = {}
         #: finish_span calls that found nothing to finish (no stack for the
@@ -222,8 +236,9 @@ class Tracer:
             parent_id=parent.span_id if parent else None,
             name=name, start=time.time())
         stack.append(span)
-        self._touched[transid.id] = time.monotonic()
-        self._expire()
+        now = time.monotonic()
+        self._touched[transid.id] = now
+        self._expire(now)
         return span
 
     def finish_span(self, transid, tags: Optional[Dict[str, str]] = None,
@@ -305,10 +320,20 @@ class Tracer:
         self._stacks.pop(transid.id, None)
         self._touched.pop(transid.id, None)
 
-    def _expire(self) -> None:
-        if len(self._touched) < 1000:
+    def _expire(self, now: Optional[float] = None) -> None:
+        """Drop abandoned transaction stacks. Two triggers: the size
+        threshold (a burst of live transactions) and an opportunistic
+        time-based sweep — without it, fewer than 1000 abandoned stacks
+        would linger FOREVER. Amortized: the sweep reuses the caller's
+        monotonic read and runs at most once per `_sweep_interval`, so
+        the per-span cost below both triggers is two comparisons."""
+        if now is None:
+            now = time.monotonic()
+        if (len(self._touched) < 1000
+                and now - self._last_sweep < self._sweep_interval):
             return
-        cutoff = time.monotonic() - self.expiry
+        self._last_sweep = now
+        cutoff = now - self.expiry
         for tid in [t for t, at in self._touched.items() if at < cutoff]:
             self._stacks.pop(tid, None)
             self._touched.pop(tid, None)

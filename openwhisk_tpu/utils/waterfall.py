@@ -44,6 +44,7 @@ pair is causally ordered.
 """
 from __future__ import annotations
 
+import functools
 import math
 import threading
 import time
@@ -114,8 +115,11 @@ def bucket_of_us(v: int, n_buckets: int) -> int:
     return min(int(v - 1).bit_length(), n_buckets - 1)
 
 
+@functools.lru_cache(maxsize=8)
 def bucket_bounds_ms(n_buckets: int) -> List[float]:
-    """Finite upper bounds in ms (2^i us); the implicit last is +Inf."""
+    """Finite upper bounds in ms (2^i us); the implicit last is +Inf.
+    Cached: tail_threshold_ms() reads the bounds once per completion
+    verdict (ISSUE 18) — callers must not mutate the returned list."""
     return [(2 ** i) / 1000.0 for i in range(max(1, n_buckets - 1))]
 
 
@@ -329,11 +333,13 @@ class ActivationWaterfall:
             self._fold_locked(row)
         return row
 
-    def finish_many(self, aids) -> int:
+    def finish_many(self, aids, rows_out: Optional[list] = None) -> int:
         """The batch-shaped completion path's fold: N finishes under ONE
         lock acquisition (the per-ack lock round trip was real work at
         thousands of completions/s). Semantically identical to calling
-        finish() per id; returns how many rows folded."""
+        finish() per id; returns how many rows folded. `rows_out` (ISSUE
+        18) collects the computed rows for the caller — the trace store's
+        completion verdict reads them without recomputing the vectors."""
         rows = []
         pop = self._active.pop
         for aid in aids:
@@ -342,6 +348,8 @@ class ActivationWaterfall:
                 row = self._compute_row(aid, ctx)
                 if row is not None:
                     rows.append(row)
+        if rows_out is not None:
+            rows_out.extend(rows)
         if not rows:
             return 0
         with self._lock:
@@ -362,6 +370,19 @@ class ActivationWaterfall:
                 sl.pop(0)
 
     # -- read side ---------------------------------------------------------
+    def tail_threshold_ms(self) -> Optional[float]:
+        """The live tail threshold for the trace store's `slow` verdict
+        (ISSUE 18): the upper bound of the host-side p99 bucket, already
+        refreshed every `_TAIL_REFRESH` finishes by the fold — reading it
+        is one GIL-atomic attribute load, no lock, no scan. None while
+        the series is empty or the p99 sits in the overflow bucket (the
+        caller falls back to the SLO e2e target)."""
+        tb = self._tail_bucket
+        bounds = bucket_bounds_ms(self.n_buckets)
+        if self._finished == 0 or tb >= len(bounds):
+            return None
+        return bounds[tb]
+
     @staticmethod
     def _pctl_bucket(counts: List[int], q: float) -> int:
         total = sum(counts)

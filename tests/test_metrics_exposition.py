@@ -216,6 +216,15 @@ class TestExpositionFormat:
                 from openwhisk_tpu.utils.tracing import \
                     export_tracing_gauges
                 export_tracing_gauges(bal.metrics)
+                # the trace observatory's counters (ISSUE 18) ride the
+                # same page via the balancer's registered renderer: one
+                # deterministic keep + one drop so both families render
+                from openwhisk_tpu.utils.tracestore import \
+                    GLOBAL_TRACE_STORE
+                GLOBAL_TRACE_STORE.reset()
+                GLOBAL_TRACE_STORE.complete("probe0", "feedbeef", 5.0,
+                                            forced=True)
+                GLOBAL_TRACE_STORE.complete("probe1", "feedbee1", 0.0)
                 # HBM gauges: the CPU backend has no memory_stats, so feed
                 # the guarded reader a canned answer — this validates the
                 # loadbalancer_hbm_* family names against the grammar
@@ -236,6 +245,10 @@ class TestExpositionFormat:
                             f"http://127.0.0.1:{PORT}/metrics") as r:
                         return r.status, await r.text()
             finally:
+                from openwhisk_tpu.utils.tracestore import \
+                    GLOBAL_TRACE_STORE
+                GLOBAL_TRACE_STORE.reset()
+                GLOBAL_TRACE_STORE.detach()
                 await controller.stop()
                 for inv in invokers:
                     await inv.stop()
@@ -299,6 +312,12 @@ class TestExpositionFormat:
                 'transition="firing"} 1') in text
         # tracing health gauges (satellite: orphan finishes are visible)
         assert types["openwhisk_tracing_orphan_finishes"] == "gauge"
+        # the trace observatory's tail-sampling verdict counters
+        # (ISSUE 18) ride the page via the balancer's registered renderer
+        assert types["openwhisk_trace_kept_total"] == "counter"
+        assert 'openwhisk_trace_kept_total{reason="forced"} 1' in text
+        assert types["openwhisk_trace_dropped_total"] == "counter"
+        assert "openwhisk_trace_dropped_total 1" in text
         # the HA plane's families (ISSUE 9): journal durability lag /
         # size / fsync tail + the adopted leadership epoch
         assert types["openwhisk_loadbalancer_journal_lag_batches"] == "gauge"
@@ -561,3 +580,62 @@ class TestOpenMetricsCounterNaming:
         assert "openwhisk_completions_total 2" in classic
         assert 'openwhisk_bare{k="v"} 1' in classic
         assert "openwhisk_bare_total" not in classic
+
+
+class TestTraceCounterFamilies:
+    """ISSUE 18: the trace observatory's tail-sampling verdict counters
+    pass the exposition grammar in both renderings. The store's text is
+    pure counters — `validate_exposition` demands at least one histogram
+    family per PAGE, which the live-page test above covers by composing
+    this renderer with the balancer's — so this class checks the line
+    grammar, label values and OM `_total` negotiation directly."""
+
+    def _store(self):
+        from openwhisk_tpu.utils.tracestore import (TraceStore,
+                                                    TraceTailConfig)
+        s = TraceStore(TraceTailConfig(enabled=True, keep_ring=8,
+                                       pending_limit=16, keep_floor=0.0))
+        s.complete("a0", "t0" * 8, 5.0, forced=True)
+        s.complete("a1", "t1" * 8, 5.0, error=True)
+        s.complete("a2", "t2" * 8, 5.0, error=True)
+        s.complete("a3", "t3" * 8, 0.0)  # clean: dropped
+        return s
+
+    def test_classic_grammar(self):
+        text = self._store().prometheus_text()
+        lines = text.splitlines()
+        assert "# TYPE openwhisk_trace_kept_total counter" in lines
+        assert "# TYPE openwhisk_trace_dropped_total counter" in lines
+        # every sample line matches the exposition sample grammar
+        samples = {}
+        for ln in lines:
+            if ln.startswith("#"):
+                continue
+            m = _SAMPLE.match(ln)
+            assert m, f"malformed sample line: {ln!r}"
+            samples[(m.group(1), m.group(2) or "")] = float(m.group(3))
+        # reason labels come from the verdict priority list, counts add up
+        from openwhisk_tpu.utils.tracestore import REASONS
+        kept = {parse_labels(lbl)["reason"]: v
+                for (name, lbl), v in samples.items()
+                if name == "openwhisk_trace_kept_total"}
+        assert set(kept) <= set(REASONS)
+        assert kept == {"error": 2.0, "forced": 1.0}
+        assert samples[("openwhisk_trace_dropped_total", "")] == 1.0
+
+    def test_openmetrics_counter_negotiation(self):
+        om = self._store().prometheus_text(openmetrics=True)
+        # OM types the suffix-free base name; samples keep `_total`
+        assert "# TYPE openwhisk_trace_kept counter" in om
+        assert "# TYPE openwhisk_trace_dropped counter" in om
+        assert "openwhisk_trace_kept_total{" in om
+        assert "openwhisk_trace_dropped_total 1" in om
+        assert "# TYPE openwhisk_trace_kept_total" not in om
+        assert "# TYPE openwhisk_trace_dropped_total" not in om
+
+    def test_disabled_store_renders_nothing(self):
+        from openwhisk_tpu.utils.tracestore import (TraceStore,
+                                                    TraceTailConfig)
+        s = TraceStore(TraceTailConfig(enabled=False))
+        assert s.prometheus_text() == ""
+        assert s.prometheus_text(openmetrics=True) == ""

@@ -425,10 +425,16 @@ class TestSatellites:
         assert fam not in after
 
     def test_buffer_reporter_counts_drops(self):
+        # ring retention (ISSUE 18): a full buffer evicts the OLDEST span
+        # — the newest spans are the ones a debugging session wants, and
+        # the old behavior (drop new, keep stale) made the buffer useless
+        # after the first `max_spans` reports. sent counts every report
+        # that reached the buffer; dropped counts the evictions.
         from openwhisk_tpu.utils.tracing import BufferReporter, Span
         rep = BufferReporter(max_spans=2)
         for i in range(5):
             rep.report(Span("t", f"s{i}", None, "op", 0.0, end=1.0))
         assert len(rep.spans) == 2
-        assert rep.sent_spans == 2
+        assert [s.span_id for s in rep.spans] == ["s3", "s4"]
+        assert rep.sent_spans == 5
         assert rep.dropped_spans == 3

@@ -419,7 +419,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                    gc_tune: bool = True, fleet_mesh: bool = False,
                    keep_samples: bool = False,
                    worker_ident: Optional[int] = None,
-                   stragglers=None) -> dict:
+                   stragglers=None, trace_keep_all: bool = False,
+                   trace_export: Optional[str] = None) -> dict:
     """The observatory: sweep offered rate (doubling from `rate0`) to the
     max sustainable throughput, then re-measure that rate for the headline
     row + the waterfall's per-stage budget. `fixed_rate` skips the sweep
@@ -455,6 +456,23 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
             if host_observatory:
                 GLOBAL_HOST_OBSERVATORY.reset()
                 obs_installed = GLOBAL_HOST_OBSERVATORY.install()
+        # trace observatory riders (ISSUE 18): `--trace-keep-all` forces
+        # the tail-sampling floor to 1.0 (every completion keeps) and
+        # widens the kept ring to hold a whole run; `--trace-export`
+        # dumps the kept traces as NDJSON after the run. Both arm the
+        # plane BEFORE the balancer boots (the balancer hook attaches the
+        # reporter tee at construction).
+        trace_armed = bool(trace_keep_all or trace_export)
+        if trace_armed:
+            import dataclasses
+            from openwhisk_tpu.utils.tracestore import GLOBAL_TRACE_STORE
+            GLOBAL_TRACE_STORE.enabled = True
+            if trace_keep_all:
+                GLOBAL_TRACE_STORE.config = dataclasses.replace(
+                    GLOBAL_TRACE_STORE.config, keep_floor=1.0,
+                    keep_ring=65536)
+                GLOBAL_TRACE_STORE._floor_every = 1
+            GLOBAL_TRACE_STORE.reset()
         target = _BalancerTarget(n_invokers=n_invokers, kernel=kernel,
                                  waterfall=waterfall, fleet_mesh=fleet_mesh,
                                  stragglers=stragglers)
@@ -615,6 +633,22 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
             host_raw = (GLOBAL_HOST_OBSERVATORY.raw_counts()
                         if obs_installed and worker_ident is not None
                         else None)
+            trace_stats = None
+            traces_exported = None
+            if trace_armed:
+                from openwhisk_tpu.utils.tracestore import (
+                    GLOBAL_TRACE_STORE, assemble_trace)
+                trace_stats = GLOBAL_TRACE_STORE.stats()
+                if trace_export:
+                    # NDJSON: one assembled trace tree per kept entry —
+                    # the one-JSON-line stdout contract stays untouched
+                    n_exp = 0
+                    with open(trace_export, "w") as f:
+                        for e in GLOBAL_TRACE_STORE.entries():
+                            f.write(json.dumps(assemble_trace(
+                                e.get("trace_id") or "", [e])) + "\n")
+                            n_exp += 1
+                    traces_exported = n_exp
             return {
                 "mode": "open_loop",
                 "dist": dist,
@@ -638,6 +672,10 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                 "host": host,
                 "host_raw": host_raw,
                 "n_invokers": n_invokers,
+                "trace_keep_all": bool(trace_keep_all),
+                "trace_export": trace_export,
+                "traces_exported": traces_exported,
+                "trace_stats": trace_stats,
             }
         finally:
             await target.stop()
@@ -861,6 +899,15 @@ def main() -> None:
                          "'IDX:DELAY_S[,IDX:DELAY_S...]' (bare IDX = "
                          "0.25 s); the applied map is reported in the "
                          "JSON line")
+    ap.add_argument("--trace-keep-all", action="store_true",
+                    help="force the trace observatory's tail-sampling "
+                         "floor to 1.0 for the run: every completion "
+                         "keeps its trace (widens the kept ring to hold "
+                         "the whole run)")
+    ap.add_argument("--trace-export", default=None, metavar="PATH",
+                    help="after the run, dump the kept traces as NDJSON "
+                         "(one assembled span tree per line) to PATH; "
+                         "stdout keeps its one-JSON-line contract")
     ap.add_argument("--fleet-mesh", action="store_true",
                     help="run the target balancer in fleet-mesh mode "
                          "(CONFIG_whisk_loadBalancer_fleetMesh semantics; "
@@ -875,6 +922,10 @@ def main() -> None:
                 ap.error("--stragglers is single-process only (each "
                          "--procs worker drives its own fleet twin, so "
                          "a shared straggler index is meaningless)")
+            if args.trace_keep_all or args.trace_export:
+                ap.error("--trace-keep-all/--trace-export are "
+                         "single-process only (each worker's store is "
+                         "its own; export from a single-process run)")
             out = multiproc_fixed_rate(
                 rate=args.rate, procs=args.procs, duration=args.duration,
                 p99_bound_ms=args.p99_bound_ms, dist=args.dist,
@@ -898,7 +949,9 @@ def main() -> None:
                                  fleet_mesh=args.fleet_mesh,
                                  keep_samples=args.emit_samples,
                                  worker_ident=args.worker_ident,
-                                 stragglers=args.stragglers)
+                                 stragglers=args.stragglers,
+                                 trace_keep_all=args.trace_keep_all,
+                                 trace_export=args.trace_export)
     except Exception as e:  # noqa: BLE001 — one parseable line, always
         import traceback
         traceback.print_exc(file=sys.stderr)
