@@ -43,6 +43,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import statistics
 import sys
 import time
@@ -1390,6 +1391,421 @@ def _trace_plane_overhead(repeats: int = 20, total: int = 2000,
             raise  # the fallback runner re-runs this rider on CPU
         print(f"# trace_plane_overhead failed: {e!r}", file=sys.stderr)
         return None
+
+
+def _incident_capture(clean: int = 240, straggler_salvo: int = 64,
+                      n_invokers: int = 8) -> Optional[dict]:
+    """ISSUE 19 acceptance: an injected straggler (the loadgen
+    `--stragglers` helper) drives the straggler alert to firing against a
+    journaled balancer with the incident recorder armed, and the FIRING
+    transition must auto-freeze exactly ONE forensic bundle (debounce)
+    joining >= 5 planes. Four legs in one fixture:
+
+      capture      straggler alert fires -> one bundle on disk with the
+                   alert context, anomaly score matrix, waterfall, >= 1
+                   kept trace and the journal window, written off-loop;
+      debounce     a second straggler invoker's own FIRING transition
+                   inside the window coalesces into the same bundle;
+      time-travel  the bundle's journal window replays through
+                   JournalDebugger: break-on-activation-id stops at the
+                   placing batch, run_to_end re-derives the books with 0
+                   parity mismatches, diff_books matches the captured
+                   books bit-exact;
+      fleet        GET /admin/fleet/incidents through a real Controller
+                   with a live + a dead peer answers 200 with member
+                   provenance and members_missing (never a 500).
+    """
+    import base64
+    import tempfile
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from openwhisk_tpu.controller.core import Controller
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+    from openwhisk_tpu.controller.loadbalancer.journal import PlacementJournal
+    from openwhisk_tpu.controller.loadbalancer.lean import LeanBalancer
+    from openwhisk_tpu.controller.loadbalancer.timetravel import \
+        JournalDebugger
+    from openwhisk_tpu.core.entity import (MB, ActivationId,
+                                           ControllerInstanceId, Identity,
+                                           WhiskAuthRecord)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.blackbox import GLOBAL_INCIDENTS, read_bundle
+    from openwhisk_tpu.utils.logging import NullLogging
+    from openwhisk_tpu.utils.tracestore import GLOBAL_TRACE_STORE
+    from openwhisk_tpu.utils.tracing import GLOBAL_TRACER, trace_id_of
+    from openwhisk_tpu.utils.transaction import TransactionId
+    from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL
+    from tools.loadgen import apply_stragglers
+
+    store = GLOBAL_TRACE_STORE
+    CTL_PORT, PEER_PORT = 13983, 13984
+    inc_dir = tempfile.mkdtemp(prefix="bench-incidents-")
+    jdir = tempfile.mkdtemp(prefix="bench-incidents-wal-")
+    # the recorder + a fast-firing straggler rule are armed via env
+    # BEFORE the balancer exists (plane wiring reads config at
+    # construction); everything is restored in the finally
+    env_overrides = {
+        "CONFIG_whisk_incidents_enabled": "true",
+        "CONFIG_whisk_incidents_directory": inc_dir,
+        # one incident -> ONE bundle across the whole rider (camelCase:
+        # the env parser splits on _, so debounce_s would nest wrong)
+        "CONFIG_whisk_incidents_debounceS": "600",
+        # the built-in straggler rule holds for 30 s before firing — an
+        # operator tightening it for a drill is exactly this override
+        "CONFIG_whisk_alerts_rules":
+            '{"straggler": {"threshold": 2.0, "for_s": 0}}',
+    }
+    env_was = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+
+    async def go() -> dict:
+        was_enabled, was_floor = store.enabled, store._floor_every
+        wf_was = GLOBAL_WATERFALL.enabled
+        store.enabled = True
+        store._floor_every = 20
+        store.reset()
+        store.attach()
+        GLOBAL_WATERFALL.enabled = True
+        GLOBAL_WATERFALL.reset()
+
+        provider = MemoryMessagingProvider()
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          kernel="xla")
+        assert GLOBAL_INCIDENTS.stats()["installed"], \
+            "recorder must arm at balancer construction"
+        bal.attach_journal(PlacementJournal(jdir))
+        await bal.start()
+        feeds, stop_fleet = await _echo_fleet(provider, n_invokers)
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= n_invokers:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("incident rider: fleet unhealthy")
+
+        actions = [_bench_action(f"ic{i}", memory=128) for i in range(4)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(32)
+
+        async def one(i):
+            # the traced invoke.py driver shape, so completions feed the
+            # tail sampler and the bundle gets real kept traces
+            async with sem:
+                action = actions[i % len(actions)]
+                transid = TransactionId()
+                span = GLOBAL_TRACER.start_span("controller_activation",
+                                                transid)
+                msg = ActivationMessage(
+                    transid, action.fully_qualified_name, action.rev.rev,
+                    ident, ActivationId.generate(),
+                    ControllerInstanceId("0"), True, {},
+                    trace_context=GLOBAL_TRACER.get_trace_context(transid))
+                GLOBAL_WATERFALL.adopt(
+                    msg.activation_id.asString, GLOBAL_WATERFALL.open(),
+                    trace_id=trace_id_of(msg.trace_context))
+                promise = await bal.publish(action, msg)
+                GLOBAL_TRACER.finish_span(
+                    transid, {"activationId": msg.activation_id.asString,
+                              "proc": "controller0"}, span=span)
+                await promise
+
+        out = {}
+        try:
+            # -- leg 1: drive to firing, capture one bundle ---------------
+            # clean bulk first: per-invoker latency estimates must be warm
+            # (min_samples) before a straggler can z-score against them
+            await asyncio.gather(*[one(i) for i in range(clean)])
+            # two delayed invokers: each (rule, invoker) instance fires on
+            # its own -> the SECOND transition proves the debounce
+            applied = apply_stragglers(feeds, {0: 0.6, 1: 0.6})
+            assert len(applied) == 2
+            salvo = 0
+            for _ in range(20):  # keep driving until the alert lands
+                await asyncio.gather(*[one(i) for i in range(
+                    straggler_salvo)])
+                salvo += straggler_salvo
+                if GLOBAL_INCIDENTS.stats()["captured"] >= 1:
+                    break
+            apply_stragglers(feeds, {0: 0.0, 1: 0.0})
+            for _ in range(200):  # the capture worker writes off-loop
+                st = GLOBAL_INCIDENTS.stats()
+                if st["captured"] >= 1 and st["bundles"] >= 1:
+                    break
+                await asyncio.sleep(0.1)
+            stats = GLOBAL_INCIDENTS.stats()
+            assert stats["captured"] >= 1, f"no capture: {stats}"
+            # let any queued coalesced triggers settle, then the debounce
+            # verdict: ONE bundle, everything else folded into it
+            await asyncio.sleep(1.0)
+            bundles = sorted(
+                n for n in os.listdir(inc_dir) if n.endswith(".wbb"))
+            assert len(bundles) == 1, f"debounce leak: {bundles}"
+            bundle_path = os.path.join(inc_dir, bundles[0])
+            payload = read_bundle(bundle_path)
+            assert payload is not None, "bundle unreadable"
+            assert payload["reason"].startswith("alert:straggler"), payload[
+                "reason"]
+            planes = {k: v for k, v in payload["planes"].items()
+                      if v is not None}
+            assert len(planes) >= 5, f"planes: {sorted(planes)}"
+            for need in ("alerts", "anomaly_scores", "waterfall",
+                         "traces", "journal", "books"):
+                assert need in planes, f"missing plane {need}"
+            assert planes["traces"], "no kept trace overlapped the window"
+            recs = planes["journal"]["records"]
+            assert recs, "journal window empty"
+
+            # -- leg 2: time-travel replay of the bundle's window ---------
+            batch_aids = [a for r in recs if r.get("t") == "batch"
+                          for a in (r.get("aids") or [])]
+            assert batch_aids, "no batch records in the window"
+            dbg = JournalDebugger.from_bundle(payload)
+            try:
+                stop = dbg.run_to_activation(batch_aids[0])
+                assert stop is not None, "break-on-activation-id missed"
+                assert batch_aids[0] in stop["aids"]
+                replay_stats = dbg.run_to_end()
+                diff = dbg.diff_books()
+            finally:
+                await dbg.aclose()
+            assert replay_stats["parity_mismatches"] == 0, replay_stats
+            assert diff["match"], diff
+
+            # -- leg 3: federated serving with a dead peer ----------------
+            async def noop_factory(invoker_id, prov):
+                class _S:
+                    async def stop(self):
+                        pass
+
+                return _S()
+
+            logger = NullLogging()
+            cprov = MemoryMessagingProvider()
+            lb = LeanBalancer(cprov, ControllerInstanceId("0"),
+                              noop_factory, logger=logger,
+                              metrics=logger.metrics, user_memory=MB(512))
+            ctl = Controller(ControllerInstanceId("0"), cprov,
+                             logger=logger, load_balancer=lb)
+            admin = Identity.generate("guest")
+            await ctl.auth_store.put(WhiskAuthRecord(
+                admin.subject, [admin.namespace], [admin.authkey]))
+
+            async def peer_incidents(request):
+                return aioweb.json_response(
+                    {"incidents": [{"id": "inc-peer-0001", "ts": 1.0,
+                                    "reason": "alert:straggler"}],
+                     "stats": {}})
+
+            papp = aioweb.Application()
+            papp.router.add_get("/admin/incidents", peer_incidents)
+            prunner = aioweb.AppRunner(papp)
+            await prunner.setup()
+            await aioweb.TCPSite(prunner, "127.0.0.1", PEER_PORT).start()
+
+            class _FleetStub:
+                def peer_directory(self):
+                    return {1: f"http://127.0.0.1:{PEER_PORT}",
+                            2: "http://127.0.0.1:9"}  # dead peer
+
+                async def stop(self):
+                    pass
+
+            await ctl.start(port=CTL_PORT)
+            ctl.membership = _FleetStub()
+            hdrs = {"Authorization": "Basic " + base64.b64encode(
+                admin.authkey.compact.encode()).decode()}
+            try:
+                base = f"http://127.0.0.1:{CTL_PORT}"
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(f"{base}/admin/fleet/incidents",
+                                     headers=hdrs) as r:
+                        fleet_status = r.status
+                        fleet_body = await r.json()
+                    async with s.get(
+                            f"{base}/admin/incident/{payload['id']}",
+                            headers=hdrs) as r:
+                        get_status = r.status
+                        get_body = await r.json()
+            finally:
+                await prunner.cleanup()
+                await ctl.stop()
+            assert fleet_status == 200, f"fleet answered {fleet_status}"
+            members = {row["member"] for row in fleet_body["incidents"]}
+            assert 0 in members and 1 in members, members
+            assert fleet_body["members_missing"] == [2], fleet_body
+            assert get_status == 200 and get_body["member"] == "local"
+
+            out = {
+                "straggler_invokers": 2,
+                "straggler_delay_s": 0.6,
+                "salvo_activations": salvo,
+                "trigger_reason": payload["reason"],
+                "bundles_written": len(bundles),
+                "coalesced": stats["coalesced"],
+                "planes_captured": len(planes),
+                "planes": sorted(planes),
+                "plane_errors": payload["plane_errors"],
+                "journal_window": [planes["journal"]["from_seq"],
+                                   planes["journal"]["to_seq"]],
+                "journal_records": len(recs),
+                "break_aid_found": True,
+                "replay_parity_mismatches":
+                    replay_stats["parity_mismatches"],
+                "replay_books_match": diff["match"],
+                "fleet_status": fleet_status,
+                "fleet_members": sorted(members),
+                "members_missing": fleet_body["members_missing"],
+            }
+        finally:
+            await stop_fleet()
+            await bal.close()
+            for f in feeds:
+                await f.stop()
+            store.detach()
+            store.enabled = was_enabled
+            store._floor_every = was_floor
+            store.reset()
+            GLOBAL_WATERFALL.enabled = wf_was
+            GLOBAL_WATERFALL.reset()
+        return out
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# incident_capture failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        for k, v in env_was.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _incident_overhead(repeats: int = 20, total: int = 1000,
+                       concurrency: int = 64) -> Optional[dict]:
+    """ISSUE 19 gate: the ARMED-but-idle incident recorder's marginal
+    cost on the blocking-publish path, <= 5% by acceptance (expected ~0:
+    arming costs one forced EventLog bool plus an alert-transition
+    listener that a healthy run never invokes — nothing per placement).
+    Same paired-segment protocol as `_fleet_observatory_overhead`
+    (fixture built ONCE, armed/disarmed segments back-to-back, order
+    flipped per repeat, 20%-trimmed mean over the pairs); install/
+    uninstall runs BETWEEN segments so thread start/join never lands in
+    a measured window."""
+    from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+    from openwhisk_tpu.core.entity import (ActivationId, ControllerInstanceId,
+                                           Identity)
+    from openwhisk_tpu.messaging import (ActivationMessage,
+                                         MemoryMessagingProvider)
+    from openwhisk_tpu.utils.blackbox import GLOBAL_INCIDENTS
+    from openwhisk_tpu.utils.transaction import TransactionId
+
+    import tempfile
+    inc_dir = tempfile.mkdtemp(prefix="bench-incover-")
+    env_overrides = {
+        "CONFIG_whisk_incidents_enabled": "true",
+        "CONFIG_whisk_incidents_directory": inc_dir,
+    }
+    env_was = {k: os.environ.get(k) for k in env_overrides}
+
+    async def go() -> dict:
+        provider = MemoryMessagingProvider()
+        # env not yet flipped: the balancer must NOT auto-own the
+        # recorder — the rider arms/disarms it per segment
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          kernel="xla")
+        os.environ.update(env_overrides)
+        await bal.start()
+        feeds, stop_fleet = await _echo_fleet(provider, 16)
+        from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= 16:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("incident overhead rider: fleet unhealthy")
+
+        actions = [_bench_action(f"io{i}", memory=128) for i in range(8)]
+        ident = Identity.generate("guest")
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            action = actions[i % len(actions)]
+            msg = ActivationMessage(
+                TransactionId(), action.fully_qualified_name, action.rev.rev,
+                ident, ActivationId.generate(), ControllerInstanceId("0"),
+                True, {})
+            async with sem:
+                promise = await bal.publish(action, msg)
+                await promise
+
+        async def segment() -> float:
+            t0 = time.perf_counter()
+            await asyncio.gather(*[one(i) for i in range(total)])
+            return total / (time.perf_counter() - t0)
+
+        token = object()
+        try:
+            await segment()  # warmup: compile + settle
+            pairs = []
+            on_rates, off_rates = [], []
+            for k in range(repeats):
+                order = (True, False) if k % 2 == 0 else (False, True)
+                rate = {}
+                for armed in order:
+                    if armed:
+                        assert GLOBAL_INCIDENTS.install(balancer=bal,
+                                                        owner=token)
+                    else:
+                        GLOBAL_INCIDENTS.uninstall(owner=token)
+                    rate[armed] = await segment()
+                GLOBAL_INCIDENTS.uninstall(owner=token)
+                on_rates.append(rate[True])
+                off_rates.append(rate[False])
+                pairs.append(100.0 * (rate[False] - rate[True])
+                             / rate[False])
+        finally:
+            GLOBAL_INCIDENTS.uninstall(owner=token)
+            await stop_fleet()
+            await bal.close()
+            for f in feeds:
+                await f.stop()
+        trim = max(1, len(pairs) // 5)
+        kept = sorted(pairs)[trim:-trim] if len(pairs) > 2 * trim else pairs
+        return {
+            "rate_incidents_on": round(max(on_rates), 1),
+            "rate_incidents_off": round(max(off_rates), 1),
+            "overhead_pct": round(statistics.mean(kept), 2),
+            "target_pct": 5.0,
+            "pair_overheads_pct": [round(p, 2) for p in pairs],
+            "repeats": repeats,
+            "agg": "trimmed_mean_paired_segments",
+        }
+
+    try:
+        return asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 — rider is auxiliary
+        if _backend_unavailable(e):
+            raise  # the fallback runner re-runs this rider on CPU
+        print(f"# incident_overhead failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        for k, v in env_was.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _placement_quality(total: int = 400, concurrency: int = 32,
@@ -3279,13 +3695,27 @@ def _host_info() -> dict:
     rounds land on a noisy shared machine — python/cpu/loadavg make rounds
     comparable (a 4x loadavg delta explains a slow round better than any
     code diff does)."""
-    import os
     import platform
+    import subprocess
     la = os.getloadavg()[0] if hasattr(os, "getloadavg") else None
+    # which code produced this round (ISSUE 19 satellite): a BENCH json
+    # on disk outlives branch switches, so the line must carry its own
+    # provenance — bench_compare prints it in the diff header
+    commit = None
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        commit = r.stdout.strip() or None
+    except Exception:  # noqa: BLE001 — no git is not an error
+        commit = None
     return {
         "python": platform.python_version(),
         "cpu_count": os.cpu_count(),
         "loadavg_1m_start": round(la, 2) if la is not None else None,
+        "git_commit": commit,
+        "round": os.environ.get("BENCH_ROUND") or None,
     }
 
 
@@ -3348,6 +3778,8 @@ def _run(args) -> Optional[dict]:
     sharded_fleet_sweep = None
     trace_assembly = None
     trace_plane_overhead = None
+    incident_capture = None
+    incident_overhead = None
     if not args.quick:
         # the new headline first: the open-loop observatory (sustained
         # activations/s + the per-stage budget the next PR attacks)
@@ -3387,6 +3819,14 @@ def _run(args) -> Optional[dict]:
         trace_assembly = timed_rider("_trace_assembly", _trace_assembly)
         trace_plane_overhead = timed_rider("_trace_plane_overhead",
                                            _trace_plane_overhead)
+        # ISSUE 19: the incident forensics observatory — a straggler-
+        # driven alert must freeze exactly one >= 5-plane bundle whose
+        # journal window time-travel-replays with zero mismatches, and
+        # the armed-idle recorder stays under the house 5% gate
+        incident_capture = timed_rider("_incident_capture",
+                                       _incident_capture)
+        incident_overhead = timed_rider("_incident_overhead",
+                                        _incident_overhead)
         repair_vs_scan = timed_rider("_repair_vs_scan", _repair_vs_scan)
         # ROADMAP item 2: placement rate per fleet size over the
         # ('fleet',) mesh (the MULTICHIP dryrun folded into the bench)
@@ -3532,6 +3972,10 @@ def _run(args) -> Optional[dict]:
         out["trace_assembly"] = trace_assembly
     if trace_plane_overhead is not None:
         out["trace_plane_overhead"] = trace_plane_overhead
+    if incident_capture is not None:
+        out["incident_capture"] = incident_capture
+    if incident_overhead is not None:
+        out["incident_overhead"] = incident_overhead
     if any(isinstance(r, dict) and r.get("backend") == "cpu_fallback"
            for r in (recorder_overhead, telemetry_overhead,
                      profiling_overhead, anomaly_overhead,
@@ -3541,6 +3985,7 @@ def _run(args) -> Optional[dict]:
                      bus_coalesce_speedup, failover_downtime,
                      partition_chaos, sharded_fleet_sweep,
                      trace_assembly, trace_plane_overhead,
+                     incident_capture, incident_overhead,
                      host_profiling_overhead, host_observatory)):
         # a rider lost the device mid-run and re-ran on CPU: say so at the
         # top level so trajectory readers never mistake a CPU number for a

@@ -166,6 +166,21 @@ class ControllerApi:
         r.add_get("/admin/traces", self.traces_list)
         r.add_get("/admin/trace/local/{trace_id}", self.trace_local)
         r.add_get("/admin/trace/{trace_id}", self.trace_assembled)
+        # admin surface index (ISSUE 19 satellite): every /admin route
+        # with its config-knob state — the surface is past 20 routes with
+        # zero discoverability. Auth-gated like everything under /admin.
+        r.add_get("/admin", self.admin_index)
+        # incident forensics observatory (ISSUE 19): alert-triggered
+        # black-box bundles (utils/blackbox.py). The `local` leaf must
+        # register before the parameterized route (aiohttp registration
+        # order, same as traces); the fleet view federates peers'
+        # summaries through the PR 16 scraper with member provenance.
+        # Every handler 404s while CONFIG_whisk_incidents_enabled=false.
+        r.add_get("/admin/incidents", self.incidents_list)
+        r.add_get("/admin/incident/local/{incident_id}",
+                  self.incident_local)
+        r.add_get("/admin/incident/{incident_id}", self.incident_get)
+        r.add_get("/admin/fleet/incidents", self.fleet_incidents)
         return app
 
     # ----------------------------------------------------------- middleware
@@ -419,16 +434,36 @@ class ControllerApi:
 
     async def placement_explain(self, request):
         """Why did activation X land on invoker Y: the recorded decision row
-        plus the batch record it rode in (input digest + phase timings).
+        plus the batch record it rode in (input digest + phase timings),
+        cross-linked to the kept trace (if the tail sampler kept one) and
+        any incident bundles whose window covers this activation — the
+        triage jumping-off points, one lookup instead of three.
         404 once the ring has wrapped past the activation."""
+        aid = request.match_info["activation_id"]
         fr = self._flight_recorder()
-        found = (fr.explain(request.match_info["activation_id"])
-                 if fr is not None else None)
+        found = fr.explain(aid) if fr is not None else None
         if found is None:
             return _error(
                 404, "activation not in the flight recorder (never placed "
                 "by this controller, recorder disabled, or the ring has "
                 "wrapped past it)", request.get("transid"))
+        trace_id = (found.get("batch") or {}).get(
+            "digest", {}).get("trace_id")
+        store = self._trace_store()
+        if store is not None:
+            kept = next((r["trace_id"] for r in store.list(n=4096)
+                         if r.get("activation_id") == aid
+                         and r.get("trace_id")), None)
+            trace_id = kept or trace_id
+        rec = self._incidents()
+        incident_ids = []
+        if rec is not None:
+            # bundle index scan reads retention-bounded files — worker
+            # thread, never on the event loop
+            incident_ids = await asyncio.to_thread(
+                rec.incidents_for_activation, aid)
+        found["cross_links"] = {"trace_id": trace_id,
+                                "incident_ids": incident_ids}
         return web.json_response(found)
 
     async def slo_report(self, request):
@@ -972,6 +1007,184 @@ class ControllerApi:
                     halves.append(body["entry"])
         return web.json_response(
             assemble_trace(tid, halves, members_missing=missing))
+
+    # --------------------------------------------- incident forensics
+    def _incidents(self):
+        from ..utils.blackbox import GLOBAL_INCIDENTS
+        return GLOBAL_INCIDENTS if GLOBAL_INCIDENTS.enabled else None
+
+    def _incidents_disabled(self, request):
+        return _error(404, "the incident forensics observatory is "
+                      "disabled (CONFIG_whisk_incidents_enabled=false)",
+                      request.get("transid"))
+
+    async def incidents_list(self, request):
+        """Captured incident bundles, newest first: summary rows (trigger,
+        planes captured, journal window, coalesced count) plus the
+        recorder's counters. The rows are the in-memory index — no disk
+        read on this path."""
+        rec = self._incidents()
+        if rec is None:
+            return self._incidents_disabled(request)
+        return web.json_response({"incidents": rec.list_incidents(),
+                                  "stats": rec.stats()})
+
+    async def incident_local(self, request):
+        """This process's copy of one bundle — the leaf the federated
+        lookup scrapes from every peer. Unknown ids answer 200
+        `{"found": false}` (a live peer that never captured the incident
+        is NOT a missing member); only a disabled plane 404s. The bundle
+        read is a CRC-checked file parse — worker thread, never on the
+        event loop."""
+        rec = self._incidents()
+        if rec is None:
+            return self._incidents_disabled(request)
+        iid = request.match_info["incident_id"]
+        payload = await asyncio.to_thread(rec.get, iid)
+        return web.json_response({"incident_id": iid,
+                                  "found": payload is not None,
+                                  "incident": payload})
+
+    async def incident_get(self, request):
+        """One full forensic bundle. Local bundles answer directly; an id
+        this process never captured falls through to the live peer
+        directory's `local` leaves (per-peer failures degrade to
+        `members_missing`, never a 500)."""
+        rec = self._incidents()
+        if rec is None:
+            return self._incidents_disabled(request)
+        iid = request.match_info["incident_id"]
+        payload = await asyncio.to_thread(rec.get, iid)
+        if payload is not None:
+            return web.json_response({"incident": payload,
+                                      "member": "local"})
+        cfg = self._fleet_cfg()
+        if cfg is not None:
+            peers, missing = await self._fleet_scrape(
+                request, cfg, f"/admin/incident/local/{iid}")
+            for k in sorted(peers):
+                body = peers[k] or {}
+                if body.get("found") and body.get("incident"):
+                    return web.json_response(
+                        {"incident": body["incident"], "member": k,
+                         "members_missing": missing})
+        return _error(404, "incident not found (unknown id, pruned by "
+                      "retention, or corrupt bundle)",
+                      request.get("transid"))
+
+    async def fleet_incidents(self, request):
+        """Fleet-wide incident list with member provenance: this
+        process's summary rows plus every live peer's, newest first.
+        A dead (or incidents-disabled) peer degrades to
+        `members_missing` — this endpoint answers 200 with whatever
+        arrived, never a 500."""
+        cfg = self._fleet_cfg()
+        if cfg is None:
+            return self._fleet_disabled(request)
+        # same key space as the peer directory (instance ints), so a
+        # reader can join rows against /admin/fleet/metrics members
+        inst = getattr(getattr(self.c, "instance", None), "instance", None)
+        me = inst if inst is not None else "local"
+        rows = []
+        rec = self._incidents()
+        if rec is not None:
+            for row in rec.list_incidents():
+                rows.append({**row, "member": me})
+        peers, missing = await self._fleet_scrape(
+            request, cfg, "/admin/incidents")
+        for k in sorted(peers):
+            body = peers[k] or {}
+            for row in body.get("incidents") or ():
+                if isinstance(row, dict):
+                    rows.append({**row, "member": k})
+        rows.sort(key=lambda r: r.get("ts") or 0.0, reverse=True)
+        return web.json_response({"incidents": rows,
+                                  "members_missing": missing})
+
+    # --------------------------------------------- admin surface index
+    async def admin_index(self, request):
+        """Every documented /admin route with its config-knob state
+        (ISSUE 19 satellite). `enabled: false` rows answer 404 with a
+        `disabled (CONFIG_...)` message when probed — the conformance
+        suite (tests/test_admin_conformance.py) holds the surface to
+        exactly this contract."""
+        return web.json_response({"routes": self._admin_routes()})
+
+    def _admin_routes(self) -> list:
+        lb = self.c.load_balancer
+        fr = self._flight_recorder()
+        qp = getattr(lb, "quality", None)
+        prof = getattr(lb, "profiler", None)
+        from ..utils.hostprof import GLOBAL_HOST_OBSERVATORY as obs
+        fleet_on = self._fleet_cfg() is not None
+        traces_on = self._trace_store() is not None
+        incidents_on = self._incidents() is not None
+
+        def row(path, method, knob, enabled):
+            return {"path": path, "method": method, "knob": knob,
+                    "enabled": bool(enabled)}
+
+        return [
+            row("/admin", "GET", None, True),
+            row("/admin/placement/recent", "GET",
+                "CONFIG_whisk_loadBalancer_flightRecorder_enabled",
+                fr is not None),
+            row("/admin/placement/explain/{activation_id}", "GET",
+                "CONFIG_whisk_loadBalancer_flightRecorder_enabled",
+                fr is not None),
+            row("/admin/placement/occupancy", "GET", None,
+                lb is not None),
+            row("/admin/placement/quality", "GET",
+                "CONFIG_whisk_placementQuality_enabled",
+                qp is not None and qp.enabled),
+            row("/admin/slo", "GET", None,
+                getattr(lb, "telemetry", None) is not None),
+            row("/admin/profile/kernel", "GET",
+                "CONFIG_whisk_profiling_enabled", prof is not None),
+            row("/admin/profile/capture", "POST",
+                "CONFIG_whisk_profiling_enabled",
+                prof is not None and prof.enabled),
+            row("/admin/profile/host", "GET",
+                "CONFIG_whisk_hostProfiling_enabled", True),
+            row("/admin/profile/host/capture", "POST",
+                "CONFIG_whisk_hostProfiling_enabled",
+                obs.enabled and obs.sampler_running),
+            row("/admin/alerts", "GET", "CONFIG_whisk_anomaly_enabled",
+                getattr(lb, "anomaly", None) is not None),
+            row("/admin/anomalies", "GET", "CONFIG_whisk_anomaly_enabled",
+                getattr(lb, "anomaly", None) is not None),
+            row("/admin/latency/waterfall", "GET", None,
+                getattr(lb, "waterfall", None) is not None),
+            row("/admin/ready", "GET", None, True),
+            row("/admin/metrics/raw", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+            row("/admin/fleet/metrics", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+            row("/admin/fleet/waterfall", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+            row("/admin/fleet/slo", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+            row("/admin/fleet/host", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+            row("/admin/fleet/quality", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+            row("/admin/fleet/timeline", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+            row("/admin/traces", "GET",
+                "CONFIG_whisk_tracing_tail_enabled", traces_on),
+            row("/admin/trace/local/{trace_id}", "GET",
+                "CONFIG_whisk_tracing_tail_enabled", traces_on),
+            row("/admin/trace/{trace_id}", "GET",
+                "CONFIG_whisk_tracing_tail_enabled", traces_on),
+            row("/admin/incidents", "GET",
+                "CONFIG_whisk_incidents_enabled", incidents_on),
+            row("/admin/incident/local/{incident_id}", "GET",
+                "CONFIG_whisk_incidents_enabled", incidents_on),
+            row("/admin/incident/{incident_id}", "GET",
+                "CONFIG_whisk_incidents_enabled", incidents_on),
+            row("/admin/fleet/incidents", "GET",
+                "CONFIG_whisk_fleetObservatory_enabled", fleet_on),
+        ]
 
     async def placement_occupancy(self, request):
         """Per-invoker slots-in-use/capacity derived from the balancer

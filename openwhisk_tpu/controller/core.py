@@ -147,7 +147,12 @@ class Controller:
         # the balancer so the block tracks ownership changes)
         from ..utils.eventlog import GLOBAL_EVENT_LOG, set_identity
         fleet_on = self.fleet_config.enabled
-        GLOBAL_EVENT_LOG.enabled = fleet_on
+        # an armed incident recorder (utils/blackbox.py) forces the event
+        # log on — its structural-distress triggers arrive through it —
+        # so a fleet-off deployment must not disarm it here
+        from ..utils.blackbox import GLOBAL_INCIDENTS
+        incidents_armed = GLOBAL_INCIDENTS.stats()["installed"]
+        GLOBAL_EVENT_LOG.enabled = fleet_on or incidents_armed
         if fleet_on:
             lb_ = self.load_balancer
 

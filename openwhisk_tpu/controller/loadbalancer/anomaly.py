@@ -225,6 +225,10 @@ class AlertEngine:
         #: tick mutates the live dicts on the event loop — the renderer
         #: must only ever iterate these immutable-once-published copies
         self._exposition: Tuple[dict, dict] = ({}, {})
+        #: transition observers `(now, rule, labels, old, new, value)` —
+        #: the incident recorder's firing trigger (ISSUE 19). Synchronous,
+        #: must never block or raise into the evaluation tick.
+        self.listeners: List[Callable] = []
 
     def _transition(self, now: float, rule: AlertRule, labels: LabelSet,
                     old: str, new: str, value: Optional[float]) -> None:
@@ -243,6 +247,11 @@ class AlertEngine:
             self.logger.warn(
                 None, f"alert {rule.name}{dict(labels)} {old} -> {new} "
                 f"(value={value}, severity={rule.severity})", "AlertEngine")
+        for fn in tuple(self.listeners):
+            try:
+                fn(now, rule, labels, old, new, value)
+            except Exception:  # noqa: BLE001 — observability never blocks
+                pass
 
     def evaluate(self, now: float,
                  signals: Dict[str, List[Tuple[LabelSet, float]]]) -> None:

@@ -31,6 +31,7 @@ from ...messaging.message import (AcknowledgementMessage, ActivationMessage,
 from ...utils.config import load_config
 from ...utils.eventlog import GLOBAL_EVENT_LOG
 from ...utils.logging import MetricEmitter
+from ...utils.blackbox import GLOBAL_INCIDENTS
 from ...utils.tracestore import GLOBAL_TRACE_STORE
 from ...utils.tracing import trace_id_of
 from ...utils.transaction import TransactionId
@@ -318,6 +319,16 @@ class CommonLoadBalancer(LoadBalancer):
             self.trace_store.placement_lookup = self._trace_placement_lookup
             self._trace_renderer = self.trace_store.prometheus_text
             self.metrics.register_renderer(self._trace_renderer)
+        # the incident forensics observatory (ISSUE 19, process-global
+        # like the host observatory, default OFF): alert-triggered
+        # black-box bundles joining every plane above. install() is a
+        # refused no-op when disabled or already owned — first balancer
+        # in a shared test process wins, and only the owner detaches.
+        self.incidents = GLOBAL_INCIDENTS
+        self._incidents_renderer = None
+        if self.incidents.install(balancer=self, owner=self):
+            self._incidents_renderer = self.incidents.prometheus_text
+            self.metrics.register_renderer(self._incidents_renderer)
 
     # -- health test actions (ref InvokerPool.prepare + healthAction) ------
     HEALTH_ACTION_NAMESPACE = "whisk.system"
@@ -952,6 +963,9 @@ class CommonLoadBalancer(LoadBalancer):
         self.metrics.unregister_renderer(self._quality_renderer)
         if self._trace_renderer is not None:
             self.metrics.unregister_renderer(self._trace_renderer)
+        if self._incidents_renderer is not None:
+            self.metrics.unregister_renderer(self._incidents_renderer)
+        self.incidents.uninstall(owner=self)
 
 
 def _bridge_publish_future(row: asyncio.Future, waiter: asyncio.Future) -> None:

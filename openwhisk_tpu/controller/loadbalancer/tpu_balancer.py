@@ -2304,12 +2304,37 @@ class TpuBalancer(CommonLoadBalancer):
         `pe` (per-partition epoch) map are additionally dropped PER
         PARTITION: a record whose every overlapping partition was
         superseded at-or-before its seq is a zombie owner's late flush."""
+        stats: dict = {}
+        for _ in self.replay_stepper(records, logger=logger,
+                                     from_seq=from_seq,
+                                     parts_filter=parts_filter,
+                                     foreign=foreign, stats=stats):
+            pass
+        return stats
+
+    def replay_stepper(self, records, logger=None,
+                       from_seq: Optional[int] = None,
+                       parts_filter=None, foreign: bool = False,
+                       stats: Optional[dict] = None):
+        """The replay engine behind `replay_journal`, exposed as a
+        generator for the time-travel debugger (timetravel.py): yields one
+        step dict `{seq, t, rec, detail}` per APPLIED record (acks and
+        stale/filtered records are handled internally, exactly as before),
+        so a consumer can stop at seq K, break on an activation id, or
+        inspect the re-derived books between any two steps. `stats` is a
+        caller-supplied dict mutated in place (replayed/batches/
+        parity_mismatches/last_seq...) — shared state with the driver, and
+        still correct when the consumer abandons the generator early:
+        finalization (journal un-mute, host-books refresh, last_seq) runs
+        in the generator's `finally`, i.e. also on `close()`."""
         log = logger or self.logger
+        if stats is None:
+            stats = {}
         if from_seq is not None and not foreign:
             self._journal_seq = int(from_seq)
-        stats = {"replayed": 0, "batches": 0, "parity_mismatches": 0,
-                 "from_seq": (int(from_seq) if from_seq is not None
-                              else self._journal_seq)}
+        stats.update({"replayed": 0, "batches": 0, "parity_mismatches": 0,
+                      "from_seq": (int(from_seq) if from_seq is not None
+                                   else self._journal_seq)})
         self.profiler.expect("snapshot_restore")
         recs = [r for r in records]
         # stale-epoch filter: a demoted active's already-popped write batch
@@ -2385,6 +2410,7 @@ class TpuBalancer(CommonLoadBalancer):
         # space; our own journal numbering is untouched
         cursor = (int(from_seq or 0) if foreign else self._journal_seq)
         self._journal_mute = True
+        cold = False
         try:
             for rec in recs:
                 t = rec.get("t")
@@ -2417,13 +2443,15 @@ class TpuBalancer(CommonLoadBalancer):
                                                "replay", "TpuBalancer")
                             stats["skipped"] = "mesh_topology"
                             break
-                        return self._topology_coldstart(stats, recs, got,
-                                                        log)
+                        cold = True
+                        self._topology_coldstart(stats, recs, got, log)
+                        return
+                detail = None
                 if t == "mesh":
                     pass  # topology verified above; nothing to re-apply
                 elif t == "batch":
-                    self._replay_batch(rec, acks.get(seq), replay_step,
-                                       stats)
+                    detail = self._replay_batch(rec, acks.get(seq),
+                                                replay_step, stats)
                 elif t == "fold":
                     self._replay_fold(rec, replay_release)
                 elif t == "reg":
@@ -2445,16 +2473,18 @@ class TpuBalancer(CommonLoadBalancer):
                 cursor = max(cursor, seq)
                 if not foreign:
                     self._journal_seq = cursor
+                yield {"seq": seq, "t": t, "rec": rec, "detail": detail}
         finally:
             self._journal_mute = False
-        self._set_books_now(np.asarray(self.state.free_mb))
-        stats["last_seq"] = cursor
-        if stats["parity_mismatches"] and log:
-            log.warn(None, f"journal replay re-derived "
-                           f"{stats['parity_mismatches']} decisions "
-                           "differently than the recorded readback (kernel "
-                           "knobs changed across the restart?)", "TpuBalancer")
-        return stats
+            if not cold:
+                self._set_books_now(np.asarray(self.state.free_mb))
+                stats["last_seq"] = cursor
+                if stats["parity_mismatches"] and log:
+                    log.warn(None, f"journal replay re-derived "
+                                   f"{stats['parity_mismatches']} decisions "
+                                   "differently than the recorded readback "
+                                   "(kernel knobs changed across the "
+                                   "restart?)", "TpuBalancer")
 
     def absorb_partitions(self, pids, journal, snap_doc=None,
                           logger=None) -> dict:
@@ -2531,7 +2561,7 @@ class TpuBalancer(CommonLoadBalancer):
         return stats
 
     def _replay_batch(self, rec: dict, ack: Optional[dict], replay_step,
-                      stats: dict) -> None:
+                      stats: dict) -> dict:
         R, H, B = int(rec["R"]), int(rec["H"]), int(rec["B"])
         rows, b = int(rec["rows"]), int(rec["b"])
         buf = decode_array(rec["buf"])
@@ -2548,12 +2578,19 @@ class TpuBalancer(CommonLoadBalancer):
         buf9 = np.concatenate([rel, health, req.ravel()]).astype(np.int32)
         self.state, out = replay_step(self.state, buf9, R, H, B)
         stats["batches"] += 1
+        #: per-batch evidence for the time-travel debugger (timetravel.py):
+        #: the driver (replay_journal) ignores it
+        detail: dict = {"b": b, "aids": rec.get("aids") or [],
+                        "acked": ack is not None, "mismatches": 0}
         if ack is not None:
             derived = np.asarray(out)[:b].astype(np.int64)
             recorded = np.asarray(ack["out"], np.int64)[:b]
             thr = ((recorded >> 1) & 1).astype(bool)
-            stats["parity_mismatches"] += int(
-                np.count_nonzero(derived[~thr] != recorded[~thr]))
+            mism = int(np.count_nonzero(derived[~thr] != recorded[~thr]))
+            stats["parity_mismatches"] += mism
+            detail.update({"derived": derived, "recorded": recorded,
+                           "throttled": thr, "mismatches": mism})
+        return detail
 
     def _replay_fold(self, rec: dict, replay_release) -> None:
         if "rel" in rec:

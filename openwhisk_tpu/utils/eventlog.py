@@ -131,6 +131,12 @@ class EventLog:
         self._lock = threading.Lock()
         self._ring: SeqRingBuffer[dict] = SeqRingBuffer(max(1, size))
         self._publisher: Optional[Callable[[dict], None]] = None
+        #: in-process observers beside the (single) bus publisher slot —
+        #: the incident recorder's structural-distress tap (ISSUE 19)
+        #: lives here so it never competes with FleetEvents for the
+        #: publisher. Same contract: synchronous, must never block or
+        #: raise into a recording call site.
+        self._listeners: List[Callable[[dict], None]] = []
 
     def record(self, kind: str, **fields) -> Optional[dict]:
         if not self.enabled:
@@ -143,9 +149,15 @@ class EventLog:
         with self._lock:
             rec["seq"], _ = self._ring.append(rec)
             pub = self._publisher
+            listeners = tuple(self._listeners)
         if pub is not None:
             try:
                 pub(rec)
+            except Exception:  # noqa: BLE001 — observability never blocks
+                pass
+        for fn in listeners:
+            try:
+                fn(rec)
             except Exception:  # noqa: BLE001 — observability never blocks
                 pass
         return rec
@@ -153,6 +165,16 @@ class EventLog:
     def attach_publisher(self, fn: Optional[Callable[[dict], None]]) -> None:
         with self._lock:
             self._publisher = fn
+
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def recent(self, n: int = 512) -> List[dict]:
         with self._lock:

@@ -1,0 +1,193 @@
+"""Admin surface conformance (ISSUE 19 satellite): the `GET /admin`
+index enumerates every admin route with its gating knob and live
+enabled state, and every listed route obeys one contract over real
+HTTP:
+
+  * anonymous requests answer 401 — no admin route leaks without auth,
+  * authed requests never 5xx (`/admin/ready` may answer its deliberate
+    503 ownership verdict),
+  * a route the index reports `enabled: true` never answers the
+    knob-404 (`disabled (CONFIG_...)`) — entity-404s (unknown
+    activation/trace id), 409s (capture already armed / sampler down)
+    and 400s (bad body) are all legitimate enabled answers,
+  * a route the index reports `enabled: false` answers 404 (GET) or
+    404/409 (POST captures) — it must not pretend to work.
+
+The suite derives its expectations from the index itself, so adding an
+admin route without indexing it (or indexing the wrong knob state) is
+the failure mode this file exists to catch."""
+import asyncio
+import base64
+import re
+
+import pytest
+
+from openwhisk_tpu.utils.blackbox import GLOBAL_INCIDENTS
+from openwhisk_tpu.utils.eventlog import reset_identity
+
+CTL_PORT = 13475
+
+#: substitutions for parameterized index paths — ids no process knows
+PARAMS = {"{activation_id}": "zzz-missing", "{trace_id}": "zzz-missing",
+          "{incident_id}": "inc-zzz"}
+
+
+def _controller():
+    from openwhisk_tpu.controller.core import Controller
+    from openwhisk_tpu.controller.loadbalancer.lean import LeanBalancer
+    from openwhisk_tpu.core.entity import (ControllerInstanceId, Identity,
+                                           MB)
+    from openwhisk_tpu.messaging import MemoryMessagingProvider
+    from openwhisk_tpu.utils.logging import NullLogging
+
+    async def noop_factory(invoker_id, provider):
+        class _Stub:
+            async def stop(self):
+                pass
+
+        return _Stub()
+
+    logger = NullLogging()
+    provider = MemoryMessagingProvider()
+    lb = LeanBalancer(provider, ControllerInstanceId("0"), noop_factory,
+                      logger=logger, metrics=logger.metrics,
+                      user_memory=MB(512))
+    c = Controller(ControllerInstanceId("0"), provider, logger=logger,
+                   load_balancer=lb)
+    return c, Identity.generate("guest")
+
+
+def _hdrs(ident):
+    return {"Authorization": "Basic " + base64.b64encode(
+        ident.authkey.compact.encode()).decode()}
+
+
+def _probe_path(path):
+    for k, v in PARAMS.items():
+        path = path.replace(k, v)
+    return path
+
+
+async def _sweep(port, routes, hdrs):
+    """Probe every indexed route anonymously and authed; returns
+    {path: (anon_status, authed_status, authed_body_text)}."""
+    import aiohttp
+    out = {}
+    base = f"http://127.0.0.1:{port}"
+    async with aiohttp.ClientSession() as s:
+        for row in routes:
+            url = base + _probe_path(row["path"])
+            kw = {}
+            if row["method"] == "POST":
+                # a body every enabled capture endpoint rejects with 400:
+                # the sweep must never actually arm a capture window
+                kw = {"json": {"steps": 0, "seconds": 0}}
+            async with s.request(row["method"], url, **kw) as r:
+                anon = r.status
+            async with s.request(row["method"], url, headers=hdrs,
+                                 **kw) as r:
+                out[row["path"]] = (anon, r.status, await r.text())
+    return out
+
+
+class TestAdminConformance:
+    def teardown_method(self):
+        reset_identity()
+        GLOBAL_INCIDENTS.uninstall()
+        GLOBAL_INCIDENTS.enabled = False
+
+    def _boot_and_sweep(self, port):
+        from openwhisk_tpu.core.entity import WhiskAuthRecord
+
+        async def go():
+            c, ident = _controller()
+            await c.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await c.start(port=port)
+            try:
+                import aiohttp
+                base = f"http://127.0.0.1:{port}"
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(base + "/admin") as r:
+                        anon_index = r.status
+                    async with s.get(base + "/admin",
+                                     headers=_hdrs(ident)) as r:
+                        assert r.status == 200
+                        index = await r.json()
+                routes = index["routes"]
+                probes = await _sweep(port, routes, _hdrs(ident))
+            finally:
+                await c.stop()
+            return anon_index, routes, probes
+
+        return asyncio.run(go())
+
+    def test_index_shape_and_every_route_conforms(self):
+        anon_index, routes, probes = self._boot_and_sweep(CTL_PORT)
+        assert anon_index == 401
+
+        # -- index shape: unique paths, sane methods, knob convention
+        paths = [r["path"] for r in routes]
+        assert len(paths) == len(set(paths))
+        assert "/admin" in paths
+        for must in ("/admin/incidents", "/admin/incident/{incident_id}",
+                     "/admin/fleet/incidents", "/admin/latency/waterfall",
+                     "/admin/placement/explain/{activation_id}",
+                     "/admin/trace/{trace_id}", "/admin/ready"):
+            assert must in paths, must
+        for row in routes:
+            assert row["method"] in ("GET", "POST"), row
+            assert isinstance(row["enabled"], bool), row
+            assert row["knob"] is None or \
+                row["knob"].startswith("CONFIG_whisk_"), row
+        # the default boot exercises both branches of the contract
+        assert any(r["enabled"] for r in routes)
+        assert any(not r["enabled"] for r in routes)
+        # the incidents plane defaults OFF (it writes disk bundles)
+        by_path = {r["path"]: r for r in routes}
+        assert by_path["/admin/incidents"]["enabled"] is False
+        assert by_path["/admin/incidents"]["knob"] == \
+            "CONFIG_whisk_incidents_enabled"
+
+        # -- behavior: every listed route against its indexed state
+        for row in routes:
+            anon, status, text = probes[row["path"]]
+            assert anon == 401, (row["path"], anon)
+            if row["path"] == "/admin/ready":
+                assert status in (200, 503), (row["path"], status)
+                continue
+            assert status < 500, (row["path"], status, text[:200])
+            knob_404 = status == 404 and "disabled (CONFIG_" in text
+            if row["enabled"]:
+                assert not knob_404, (row["path"], text[:200])
+            elif row["method"] == "POST":
+                # disabled captures refuse with the knob-404 (no plane)
+                # or 409 (plane present, knob off / sampler down)
+                assert status in (404, 409), (row["path"], status,
+                                              text[:200])
+            else:
+                assert status == 404, (row["path"], status, text[:200])
+
+    def test_flipping_a_knob_flips_the_index_and_the_route(self, tmp_path,
+                                                           monkeypatch):
+        """The index reports LIVE state: arming the incident recorder
+        turns its rows enabled and the endpoints start answering."""
+        monkeypatch.setenv("CONFIG_whisk_incidents_enabled", "true")
+        monkeypatch.setenv("CONFIG_whisk_incidents_directory",
+                           str(tmp_path))
+        tok = object()
+        assert GLOBAL_INCIDENTS.install(owner=tok)  # env refresh
+        try:
+            _, routes, probes = self._boot_and_sweep(CTL_PORT + 2)
+        finally:
+            GLOBAL_INCIDENTS.uninstall(owner=tok)
+        by_path = {r["path"]: r for r in routes}
+        assert by_path["/admin/incidents"]["enabled"] is True
+        _, status, text = probes["/admin/incidents"]
+        assert status == 200
+        # an unknown id on the armed plane is an entity miss, not a
+        # knob-404
+        _, status, text = probes["/admin/incident/{incident_id}"]
+        assert status == 404 and "disabled (CONFIG_" not in text
+        _, status, text = probes["/admin/incident/local/{incident_id}"]
+        assert status == 200 and '"found": false' in text

@@ -1,0 +1,655 @@
+"""Incident forensics observatory (ISSUE 19): bundle CRC framing,
+trigger debounce (one incident -> ONE bundle), fence-discard burst
+detection, bounded retention, the disabled-plane true-no-op contract
+(tracemalloc-asserted), an end-to-end capture through a real balancer
+with the journal time-travel replay over the bundle's window, and the
+incident admin endpoints including the federated lookup's dead-peer
+degradation."""
+import asyncio
+import base64
+import glob
+import json
+import os
+import time
+import tracemalloc
+import types
+
+import pytest
+
+from openwhisk_tpu.utils.blackbox import (BUNDLE_MAGIC, BUNDLE_VERSION,
+                                          GLOBAL_INCIDENTS, IncidentConfig,
+                                          IncidentRecorder, read_bundle,
+                                          write_bundle)
+from openwhisk_tpu.utils.eventlog import GLOBAL_EVENT_LOG, reset_identity
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("directory", str(tmp_path))
+    return IncidentRecorder(IncidentConfig(**kw))
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _payload(iid="inc-0000000000001-0001", **over):
+    base = {"version": BUNDLE_VERSION, "id": iid, "ts": 1000.0,
+            "reason": "alert:test", "severity": "warning", "labels": {},
+            "value": None, "coalesced": 0, "window_s": 120.0,
+            "identity": {"instance": 0}, "planes": {"events": []},
+            "plane_errors": {}, "activation_ids": []}
+    base.update(over)
+    return base
+
+
+# -- bundle file format ----------------------------------------------------
+class TestBundleFraming:
+    def test_roundtrip_and_frame_layout(self, tmp_path):
+        path = str(tmp_path / "inc-x.wbb")
+        payload = _payload(planes={"events": [{"kind": "k", "n": 3}]},
+                           activation_ids=["a1", "a2"])
+        size = write_bundle(path, payload)
+        raw = open(path, "rb").read()
+        assert len(raw) == size
+        assert raw[:len(BUNDLE_MAGIC)] == BUNDLE_MAGIC
+        assert read_bundle(path) == payload
+        # atomic write: no tmp file left behind
+        assert glob.glob(str(tmp_path / "*.tmp.*")) == []
+
+    def test_crc_flip_reads_none(self, tmp_path):
+        path = str(tmp_path / "inc-x.wbb")
+        write_bundle(path, _payload())
+        data = bytearray(open(path, "rb").read())
+        data[-2] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        assert read_bundle(path) is None
+
+    def test_truncation_and_bad_magic_read_none(self, tmp_path):
+        path = str(tmp_path / "inc-x.wbb")
+        write_bundle(path, _payload())
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[:-5])
+        assert read_bundle(path) is None
+        open(path, "wb").write(b"XXXX" + data[4:])
+        assert read_bundle(path) is None
+        open(path, "wb").write(b"WB")          # shorter than the header
+        assert read_bundle(path) is None
+
+    def test_future_version_and_missing_file_read_none(self, tmp_path):
+        path = str(tmp_path / "inc-x.wbb")
+        write_bundle(path, _payload(version=BUNDLE_VERSION + 1))
+        assert read_bundle(path) is None
+        assert read_bundle(str(tmp_path / "nope.wbb")) is None
+
+
+# -- ownership + off-switch ------------------------------------------------
+class TestOwnership:
+    def test_disabled_refuses_install(self, tmp_path):
+        rec = _recorder(tmp_path, enabled=False)
+        assert rec.install() is False
+        assert rec.stats()["installed"] is False
+
+    def test_first_owner_wins_and_uninstall_checks_owner(self, tmp_path):
+        rec = _recorder(tmp_path)
+        tok_a, tok_b = object(), object()
+        try:
+            assert rec.install(owner=tok_a) is True
+            assert rec.install(owner=tok_b) is False
+            rec.uninstall(owner=tok_b)          # not the owner: no-op
+            assert rec.stats()["installed"] is True
+        finally:
+            rec.uninstall(owner=tok_a)
+        assert rec.stats()["installed"] is False
+        # re-armable after release
+        try:
+            assert rec.install(owner=tok_b) is True
+        finally:
+            rec.uninstall(owner=tok_b)
+
+    def test_global_recorder_defaults_off_via_env_refresh(self, monkeypatch):
+        monkeypatch.delenv("CONFIG_whisk_incidents_enabled", raising=False)
+        assert GLOBAL_INCIDENTS.install() is False
+        assert GLOBAL_INCIDENTS.stats()["enabled"] is False
+
+    def test_install_restores_eventlog_enabled_on_uninstall(self, tmp_path):
+        rec = _recorder(tmp_path)
+        was = GLOBAL_EVENT_LOG.enabled
+        GLOBAL_EVENT_LOG.enabled = False
+        try:
+            assert rec.install() is True
+            assert GLOBAL_EVENT_LOG.enabled is True  # forced on while armed
+            rec.uninstall()
+            assert GLOBAL_EVENT_LOG.enabled is False  # prior state restored
+        finally:
+            rec.uninstall()
+            GLOBAL_EVENT_LOG.enabled = was
+
+
+# -- triggers + debounce ---------------------------------------------------
+class TestTriggersAndDebounce:
+    def test_debounce_coalesces_a_storm_into_one_bundle(self, tmp_path):
+        rec = _recorder(tmp_path, debounce_s=600.0)
+        try:
+            assert rec.install()
+            rec._trigger("alert:straggler", severity="critical",
+                         labels={"invoker": "invoker1"}, value=4.2)
+            rec._trigger("alert:slo_burn")
+            rec._trigger("event:spill_burst")
+            assert _wait(lambda: rec.stats()["captured"] >= 1)
+            stats = rec.stats()
+            assert stats["captured"] == 1
+            assert stats["coalesced"] == 2
+            files = glob.glob(str(tmp_path / "inc-*.wbb"))
+            assert len(files) == 1
+            payload = read_bundle(files[0])
+            assert payload["reason"] == "alert:straggler"
+            assert payload["severity"] == "critical"
+            assert payload["labels"] == {"invoker": "invoker1"}
+            assert payload["value"] == 4.2
+        finally:
+            rec.uninstall()
+
+    def test_zero_debounce_captures_every_trigger(self, tmp_path):
+        rec = _recorder(tmp_path, debounce_s=0.0)
+        try:
+            assert rec.install()
+            rec._trigger("alert:a")
+            assert _wait(lambda: rec.stats()["captured"] == 1)
+            rec._trigger("alert:b")
+            assert _wait(lambda: rec.stats()["captured"] == 2)
+            assert rec.stats()["coalesced"] == 0
+            assert len(glob.glob(str(tmp_path / "inc-*.wbb"))) == 2
+        finally:
+            rec.uninstall()
+
+    def test_distress_event_through_the_global_log(self, tmp_path):
+        rec = _recorder(tmp_path)
+        was = GLOBAL_EVENT_LOG.enabled
+        try:
+            assert rec.install()
+            GLOBAL_EVENT_LOG.record("journal_stall", lag_batches=42)
+            assert _wait(lambda: rec.stats()["captured"] >= 1)
+            files = glob.glob(str(tmp_path / "inc-*.wbb"))
+            payload = read_bundle(files[0])
+            assert payload["reason"] == "event:journal_stall"
+            assert payload["labels"]["lag_batches"] == 42
+            # the event itself is in the frozen timeline slice
+            kinds = [e["kind"] for e in payload["planes"]["events"]]
+            assert "journal_stall" in kinds
+        finally:
+            rec.uninstall()
+            GLOBAL_EVENT_LOG.enabled = was
+
+    def test_fence_discards_trigger_only_as_a_burst(self, tmp_path):
+        rec = _recorder(tmp_path, fence_burst_n=3,
+                        fence_burst_window_s=60.0)
+        try:
+            assert rec.install()
+            rec._on_event({"kind": "fence_discard"})
+            rec._on_event({"kind": "fence_discard"})
+            time.sleep(0.3)
+            assert rec.stats()["captured"] == 0  # two is routine
+            rec._on_event({"kind": "fence_discard"})
+            assert _wait(lambda: rec.stats()["captured"] == 1)
+            files = glob.glob(str(tmp_path / "inc-*.wbb"))
+            assert read_bundle(files[0])["reason"] == \
+                "event:fence_discard_burst"
+        finally:
+            rec.uninstall()
+
+    def test_non_distress_kinds_never_trigger(self, tmp_path):
+        rec = _recorder(tmp_path)
+        try:
+            assert rec.install()
+            rec._on_event({"kind": "lead_claim", "epoch": 2})
+            rec._on_event({"kind": "member_silent", "peer": 1})
+            time.sleep(0.3)
+            assert rec.stats()["captured"] == 0
+        finally:
+            rec.uninstall()
+
+    def test_alert_listener_fires_only_on_firing(self, tmp_path):
+        rec = _recorder(tmp_path)
+        rule = types.SimpleNamespace(name="straggler", severity="critical")
+        try:
+            assert rec.install()
+            rec._on_alert(0.0, rule, {"invoker": "invoker0"},
+                          "inactive", "pending", 3.0)
+            time.sleep(0.3)
+            assert rec.stats()["captured"] == 0   # pending is not firing
+            rec._on_alert(1.0, rule, {"invoker": "invoker0"},
+                          "pending", "firing", 4.0)
+            assert _wait(lambda: rec.stats()["captured"] == 1)
+            files = glob.glob(str(tmp_path / "inc-*.wbb"))
+            assert read_bundle(files[0])["reason"] == "alert:straggler"
+        finally:
+            rec.uninstall()
+
+
+# -- retention + read side -------------------------------------------------
+class TestRetentionAndReads:
+    def test_retention_ring_prunes_oldest(self, tmp_path):
+        rec = _recorder(tmp_path, retention=2, debounce_s=0.0)
+        try:
+            assert rec.install()
+            for i in range(4):
+                rec._trigger(f"alert:r{i}")
+                assert _wait(lambda: rec.stats()["captured"] == i + 1)
+            files = sorted(glob.glob(str(tmp_path / "inc-*.wbb")))
+            assert len(files) == 2
+            rows = rec.list_incidents()
+            assert len(rows) == 2
+            # newest first, and only the two survivors
+            assert rows[0]["ts"] >= rows[1]["ts"]
+            reasons = {r["reason"] for r in rows}
+            assert reasons == {"alert:r2", "alert:r3"}
+            assert rec.stats()["bundles"] == 2
+            # a kept id reads back, a pruned one is gone
+            assert rec.get(rows[0]["id"]) is not None
+        finally:
+            rec.uninstall()
+
+    def test_get_rejects_traversal_and_foreign_ids(self, tmp_path):
+        rec = _recorder(tmp_path)
+        assert rec.get("../../etc/passwd") is None
+        assert rec.get("inc-..\\x") is None
+        assert rec.get("not-an-incident") is None
+
+    def test_install_adopts_bundles_already_on_disk(self, tmp_path):
+        write_bundle(str(tmp_path / "inc-0000000000001-0001.wbb"),
+                     _payload(activation_ids=["aid-7", "aid-8"],
+                              planes={"events": [],
+                                      "books": None,  # failed grab
+                                      "journal": {"from_seq": 3,
+                                                  "to_seq": 9,
+                                                  "records": [{}] * 4}}))
+        rec = _recorder(tmp_path)
+        try:
+            assert rec.install()
+            rows = rec.list_incidents()
+            assert [r["id"] for r in rows] == ["inc-0000000000001-0001"]
+            assert rows[0]["activation_ids"] == 2  # summary carries COUNT
+            # the row's journal window comes from the journal PLANE, and
+            # planes lists only the grabs that landed (None = failed)
+            assert rows[0]["journal_from_seq"] == 3
+            assert rows[0]["journal_to_seq"] == 9
+            assert rows[0]["journal_records"] == 4
+            assert rows[0]["planes"] == ["events", "journal"]
+            assert rec.incidents_for_activation("aid-7") == \
+                ["inc-0000000000001-0001"]
+            assert rec.incidents_for_activation("aid-zzz") == []
+        finally:
+            rec.uninstall()
+
+    def test_prometheus_text_families_and_om_idiom(self, tmp_path):
+        rec = _recorder(tmp_path, debounce_s=600.0)
+        try:
+            assert rec.install()
+            rec._trigger("alert:x")
+            rec._trigger("alert:y")
+            assert _wait(lambda: rec.stats()["captured"] == 1)
+            text = rec.prometheus_text()
+            assert "# TYPE openwhisk_incidents_captured_total counter" \
+                in text
+            assert "openwhisk_incidents_captured_total 1" in text
+            assert "openwhisk_incidents_coalesced_total 1" in text
+            assert "openwhisk_incidents_bundles 1" in text
+            om = rec.prometheus_text(openmetrics=True)
+            # OM types the base name, samples keep the _total suffix
+            assert "# TYPE openwhisk_incidents_captured counter" in om
+            assert "openwhisk_incidents_captured_total 1" in om
+        finally:
+            rec.uninstall()
+        assert _recorder(tmp_path, enabled=False).prometheus_text() == ""
+
+
+# -- disabled plane: a true no-op ------------------------------------------
+class TestDisabledNoOp:
+    def test_disabled_recorder_is_a_true_noop(self):
+        """ISSUE 19 contract, tracemalloc-asserted: with the plane off,
+        install refuses, every trigger path returns immediately, no
+        thread starts, no directory is created, nothing renders."""
+        rec = IncidentRecorder(IncidentConfig(enabled=False,
+                                              directory="/nonexistent/x"))
+        rule = types.SimpleNamespace(name="r", severity="warning")
+
+        def drive():
+            assert rec.install() is False
+            rec._on_alert(0.0, rule, {}, "pending", "firing", 1.0)
+            rec._on_event({"kind": "journal_stall"})
+            rec._on_event({"kind": "fence_discard"})
+            rec._trigger("alert:r")
+            assert rec.prometheus_text() == ""
+
+        drive()  # warm every path once
+        tracemalloc.start()
+        try:
+            s1 = tracemalloc.take_snapshot()
+            for _ in range(256):
+                drive()
+            s2 = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        flt = [tracemalloc.Filter(True, "*utils/blackbox.py")]
+        grown = [d for d in s2.filter_traces(flt).compare_to(
+            s1.filter_traces(flt), "lineno") if d.size_diff > 0]
+        total = sum(d.size_diff for d in grown)
+        assert total < 2048, \
+            f"disabled recorder allocated {total}B: " \
+            + "; ".join(str(d) for d in grown[:8])
+        assert rec._worker is None
+        assert rec._queue is None
+        assert not os.path.exists("/nonexistent/x")
+        assert rec.stats()["captured"] == 0
+
+
+# -- end-to-end: capture through a real balancer + time-travel replay ------
+class TestCaptureAndReplay:
+    def test_capture_replay_parity_and_books_diff(self, tmp_path,
+                                                  monkeypatch):
+        """The acceptance loop in-process: traffic through a journaled
+        TpuBalancer, a distress trigger, ONE bundle with >= 5 planes,
+        then the time-travel debugger replays the bundle's embedded
+        journal window with zero parity mismatches, breaks on an
+        activation id, and the replayed books match the frozen ones."""
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.controller.loadbalancer.journal import \
+            PlacementJournal
+        from openwhisk_tpu.controller.loadbalancer.timetravel import \
+            JournalDebugger
+        from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+        from openwhisk_tpu.messaging import MemoryMessagingProvider
+        from tests.test_balancers import (_fleet, _ping_all, make_action,
+                                          make_msg)
+
+        inc_dir = tmp_path / "incidents"
+        monkeypatch.setenv("CONFIG_whisk_incidents_enabled", "true")
+        monkeypatch.setenv("CONFIG_whisk_incidents_directory", str(inc_dir))
+        monkeypatch.setenv("CONFIG_whisk_incidents_debounceS", "600")
+        base_captured = GLOBAL_INCIDENTS.stats()["captured"]
+
+        async def go():
+            provider = MemoryMessagingProvider()
+            bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                              managed_fraction=1.0, blackbox_fraction=0.0)
+            # the balancer self-installs the env-armed global recorder
+            assert GLOBAL_INCIDENTS.stats()["installed"]
+            bal.attach_journal(PlacementJournal(str(tmp_path / "wal")))
+            await bal.start()
+            invokers, producer = await _fleet(provider, 2, delay=0.2)
+            await _ping_all(invokers, producer)
+            ident = Identity.generate("guest")
+            action = make_action("fx", memory=256)
+            try:
+                ps = [await bal.publish(action, make_msg(action, ident,
+                                                         True))
+                      for _ in range(6)]
+                await asyncio.gather(*[asyncio.wait_for(p, 15)
+                                       for p in ps])
+                for _ in range(100):
+                    if not (bal._pending or bal._releases
+                            or bal._inflight_steps):
+                        break
+                    await asyncio.sleep(0.1)
+                GLOBAL_EVENT_LOG.record("journal_stall", lag_batches=9)
+                GLOBAL_EVENT_LOG.record("spill_burst", n=2)  # coalesces
+                for _ in range(150):
+                    if GLOBAL_INCIDENTS.stats()["captured"] \
+                            > base_captured:
+                        break
+                    await asyncio.sleep(0.1)
+            finally:
+                await bal.close()
+                for inv in invokers:
+                    await inv.stop()
+            return GLOBAL_INCIDENTS.stats()
+
+        stats = asyncio.run(go())
+        assert stats["captured"] == base_captured + 1
+        assert stats["coalesced"] >= 1
+        assert stats["installed"] is False   # close() released ownership
+
+        files = glob.glob(str(inc_dir / "inc-*.wbb"))
+        assert len(files) == 1               # debounce: ONE bundle
+        payload = read_bundle(files[0])
+        planes = payload["planes"]
+        nonnull = [k for k, v in planes.items() if v is not None]
+        assert len(nonnull) >= 5, nonnull
+        for plane in ("alerts", "anomaly_scores", "waterfall", "books",
+                      "journal", "events"):
+            assert plane in nonnull, (plane, payload["plane_errors"])
+        window = planes["journal"]
+        assert window["records"], "window must carry the traffic's batches"
+        assert window["to_seq"] >= window["from_seq"]
+        batch_aids = [a for r in window["records"] if r.get("t") == "batch"
+                      for a in (r.get("aids") or ())]
+        assert batch_aids
+        assert set(batch_aids) <= set(payload["activation_ids"])
+
+        async def replay():
+            dbg = JournalDebugger.from_bundle(files[0])
+            try:
+                stop = dbg.run_to_activation(batch_aids[0])
+                assert stop is not None and stop["t"] == "batch"
+                assert batch_aids[0] in stop["aids"]
+                dec = dbg.decisions()
+                assert dec is not None and "derived" in dec
+                assert len(dbg.books()) > 0
+                stats = dbg.run_to_end()
+                assert stats["parity_mismatches"] == 0, stats
+                diff = dbg.diff_books()
+                assert diff["match"], diff
+                assert diff["captured_seq"] == window["to_seq"]
+                # break-on-unknown-aid drains to the end, returns None
+                assert dbg.run_to_activation("zzz") is None
+            finally:
+                await dbg.aclose()
+
+        asyncio.run(replay())
+
+
+# -- admin endpoints over real HTTP ----------------------------------------
+CTL_PORT = 13471
+PEER_PORT = 13472
+
+
+def _controller():
+    from openwhisk_tpu.controller.core import Controller
+    from openwhisk_tpu.controller.loadbalancer.lean import LeanBalancer
+    from openwhisk_tpu.core.entity import (ControllerInstanceId, Identity,
+                                           MB)
+    from openwhisk_tpu.messaging import MemoryMessagingProvider
+    from openwhisk_tpu.utils.logging import NullLogging
+
+    async def noop_factory(invoker_id, provider):
+        class _Stub:
+            async def stop(self):
+                pass
+
+        return _Stub()
+
+    logger = NullLogging()
+    provider = MemoryMessagingProvider()
+    lb = LeanBalancer(provider, ControllerInstanceId("0"), noop_factory,
+                      logger=logger, metrics=logger.metrics,
+                      user_memory=MB(512))
+    c = Controller(ControllerInstanceId("0"), provider, logger=logger,
+                   load_balancer=lb)
+    return c, Identity.generate("guest")
+
+
+def _hdrs(ident):
+    return {"Authorization": "Basic " + base64.b64encode(
+        ident.authkey.compact.encode()).decode()}
+
+
+class TestIncidentEndpoints:
+    def teardown_method(self):
+        reset_identity()
+        GLOBAL_INCIDENTS.uninstall()
+        GLOBAL_INCIDENTS.enabled = False
+
+    def test_auth_federation_and_dead_peer_degradation(self, tmp_path,
+                                                       monkeypatch):
+        import aiohttp
+        from aiohttp import web
+        from openwhisk_tpu.core.entity import WhiskAuthRecord
+
+        monkeypatch.setenv("CONFIG_whisk_incidents_enabled", "true")
+        monkeypatch.setenv("CONFIG_whisk_incidents_directory",
+                           str(tmp_path))
+        local_id = "inc-0000000000002-0001"
+        write_bundle(str(tmp_path / f"{local_id}.wbb"),
+                     _payload(local_id, reason="alert:straggler", ts=5.0))
+        tok = object()
+
+        async def go():
+            assert GLOBAL_INCIDENTS.install(owner=tok)  # env refresh + adopt
+            c, ident = _controller()
+            await c.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+
+            # a live peer serving the two leaf routes + a dead peer
+            peer_row = dict(_payload("inc-0000000000009-0001",
+                                     reason="event:spill_burst", ts=9.0))
+
+            async def peer_list(request):
+                return web.json_response(
+                    {"incidents": [{"id": peer_row["id"], "ts": 9.0,
+                                    "reason": peer_row["reason"]}],
+                     "stats": {}})
+
+            async def peer_local(request):
+                iid = request.match_info["incident_id"]
+                found = iid == peer_row["id"]
+                return web.json_response(
+                    {"incident_id": iid, "found": found,
+                     "incident": peer_row if found else None})
+
+            papp = web.Application()
+            papp.router.add_get("/admin/incidents", peer_list)
+            papp.router.add_get("/admin/incident/local/{incident_id}",
+                                peer_local)
+            prunner = web.AppRunner(papp)
+            await prunner.setup()
+            await web.TCPSite(prunner, "127.0.0.1", PEER_PORT).start()
+
+            class _Membership:
+                def peer_directory(self):
+                    return {1: f"http://127.0.0.1:{PEER_PORT}",
+                            2: "http://127.0.0.1:9"}  # dead peer
+
+                async def stop(self):
+                    pass
+
+            await c.start(port=CTL_PORT)
+            c.membership = _Membership()
+            out = {}
+            base = f"http://127.0.0.1:{CTL_PORT}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    for path in ("/admin/incidents",
+                                 f"/admin/incident/{local_id}",
+                                 "/admin/fleet/incidents"):
+                        async with s.get(base + path) as r:
+                            out[f"anon {path}"] = r.status
+                    h = _hdrs(ident)
+                    async with s.get(f"{base}/admin/incidents",
+                                     headers=h) as r:
+                        out["list"] = (r.status, await r.json())
+                    async with s.get(
+                            f"{base}/admin/incident/local/{local_id}",
+                            headers=h) as r:
+                        out["local"] = (r.status, await r.json())
+                    async with s.get(f"{base}/admin/incident/{local_id}",
+                                     headers=h) as r:
+                        out["get_local"] = (r.status, await r.json())
+                    async with s.get(
+                            f"{base}/admin/incident/{peer_row['id']}",
+                            headers=h) as r:
+                        out["get_peer"] = (r.status, await r.json())
+                    async with s.get(f"{base}/admin/incident/inc-zzz",
+                                     headers=h) as r:
+                        out["get_miss"] = (r.status, await r.json())
+                    async with s.get(f"{base}/admin/fleet/incidents",
+                                     headers=h) as r:
+                        out["fleet"] = (r.status, await r.json())
+            finally:
+                await prunner.cleanup()
+                await c.stop()
+            return out
+
+        out = asyncio.run(go())
+        assert out[f"anon /admin/incidents"] == 401
+        assert out[f"anon /admin/incident/{local_id}"] == 401
+        assert out["anon /admin/fleet/incidents"] == 401
+
+        status, body = out["list"]
+        assert status == 200
+        assert [r["id"] for r in body["incidents"]] == [local_id]
+        assert body["stats"]["installed"] is True
+
+        status, body = out["local"]
+        assert status == 200 and body["found"] is True
+        assert body["incident"]["id"] == local_id
+
+        status, body = out["get_local"]
+        assert status == 200 and body["member"] == "local"
+        assert body["incident"]["reason"] == "alert:straggler"
+
+        # an id this process never captured is found on the live peer;
+        # the dead peer degrades to members_missing, never a 500
+        status, body = out["get_peer"]
+        assert status == 200 and body["member"] == 1
+        assert body["incident"]["reason"] == "event:spill_burst"
+        assert body["members_missing"] == [2]
+
+        status, body = out["get_miss"]
+        assert status == 404
+        assert "incident not found" in body["error"]
+
+        status, body = out["fleet"]
+        assert status == 200
+        members = {r["member"] for r in body["incidents"]}
+        assert members == {0, 1}             # int key space, local tagged 0
+        assert body["members_missing"] == [2]
+        # newest first across the fleet: the peer's ts=9 row leads
+        assert body["incidents"][0]["id"] == "inc-0000000000009-0001"
+
+    def test_disabled_plane_404s_every_incident_route(self, monkeypatch):
+        import aiohttp
+        from openwhisk_tpu.core.entity import WhiskAuthRecord
+
+        monkeypatch.delenv("CONFIG_whisk_incidents_enabled", raising=False)
+        GLOBAL_INCIDENTS.install()           # refresh: default off
+        assert GLOBAL_INCIDENTS.enabled is False
+
+        async def go():
+            c, ident = _controller()
+            await c.auth_store.put(WhiskAuthRecord(
+                ident.subject, [ident.namespace], [ident.authkey]))
+            await c.start(port=CTL_PORT + 2)
+            out = {}
+            base = f"http://127.0.0.1:{CTL_PORT + 2}"
+            try:
+                async with aiohttp.ClientSession() as s:
+                    for path in ("/admin/incidents",
+                                 "/admin/incident/local/inc-x",
+                                 "/admin/incident/inc-x"):
+                        async with s.get(base + path,
+                                         headers=_hdrs(ident)) as r:
+                            out[path] = (r.status, await r.text())
+            finally:
+                await c.stop()
+            return out
+
+        out = asyncio.run(go())
+        for path, (status, text) in out.items():
+            assert status == 404, (path, status)
+            assert "disabled (CONFIG_whisk_incidents_enabled" in text, path

@@ -94,6 +94,16 @@ HEADLINES = (
      ("placement_quality", "shadow_divergence_ratio"), "lower"),
     ("placement_quality_overhead_pct",
      ("placement_quality_overhead", "overhead_pct"), "lower"),
+    # ISSUE 19: incident forensics — every acceptance plane must keep
+    # landing in the bundle, the time-travel replay is a determinism
+    # CONTRACT (any mismatch fails the round outright), and the armed
+    # recorder rides the house paired-overhead gate
+    ("incident_capture_planes",
+     ("incident_capture", "planes_captured"), "higher"),
+    ("incident_replay_mismatches",
+     ("incident_capture", "replay_parity_mismatches"), "zero"),
+    ("incident_overhead_pct",
+     ("incident_overhead", "overhead_pct"), "lower"),
 )
 
 
@@ -228,6 +238,16 @@ def main() -> int:
         return 2
     old, new = unwrap_round(old), unwrap_round(new)
     out = compare(old, new, args.threshold)
+    # code provenance (ISSUE 19 satellite): bench.py stamps git_commit +
+    # round label into `host`, so the diff names what code produced each
+    # side even after branches moved on
+    def _prov(doc):
+        host = doc.get("host") or {}
+        commit = host.get("git_commit") or "?"
+        rnd = host.get("round")
+        return f"{commit} (round {rnd})" if rnd else commit
+
+    print(f"# old: {_prov(old)}  ->  new: {_prov(new)}")
     if out["backend_mismatch"]:
         print(f"# BACKEND MISMATCH: old={out['backend_old']} "
               f"new={out['backend_new']} — comparison is advisory, "
