@@ -2135,6 +2135,97 @@ def _e2e_multiproc_measure(rate: float = 128.0, procs: int = 2,
     return keep
 
 
+def _funnel_10k_measure(duration: float = 2.0) -> Optional[dict]:
+    """ISSUE 20 rider body: the SHARED multi-process deployment — N
+    front-end worker processes funneling one device-owning balancer
+    process over the TCP bus — swept over front-end process count at
+    4k/8k/12k offered/s. Each point is a merged-schedule verdict
+    (topology "shared": one balancer really placed every row, so the
+    merged rate IS the system number, unlike the twins-mode sum). The
+    funnel's depth bound surfaces as 429s at the front door, which the
+    per-worker verdicts count as errors — an over-driven point fails
+    honestly instead of queueing unboundedly. The 12k rung doubles as
+    the recorded 10k/s attempt, sustained or not."""
+    import os
+    from tools.loadgen import multiproc_fixed_rate
+    cpus = os.cpu_count() or 1
+    # front-end process ladder: 2 always (the minimum real multi-process
+    # point, timesliced honestly on a small box), 4 when the box has the
+    # cores to give each front end one
+    proc_ladder = [2] if cpus < 6 else [2, 4]
+    rates = (4096.0, 8192.0, 12288.0)
+    points = []
+    best = None
+    attempt_10k = None
+    for procs in proc_ladder:
+        skip_rest = False
+        for rate in rates:
+            if skip_rest and not (rate >= 10000.0 and attempt_10k is None):
+                continue
+            row = multiproc_fixed_rate(rate=rate, procs=procs,
+                                       duration=duration, shared=True)
+            point = {k: row.get(k) for k in (
+                "topology", "procs", "offered_rate", "sustained",
+                "sustained_activations_per_sec",
+                "fleet_merged_sustained_per_sec", "completed", "p50_ms",
+                "p99_ms")}
+            point["worker_verdicts"] = [
+                {"worker": w.get("worker"),
+                 "sustained": w.get("sustained"),
+                 "blames": w.get("blames"),
+                 "error": w.get("error"),
+                 "failed": (w.get("verdict") or {}).get("failed")}
+                for w in row.get("per_worker") or []]
+            points.append(point)
+            if rate >= 10000.0:
+                attempt_10k = point
+            if point["sustained"]:
+                if (best is None or
+                        (point["fleet_merged_sustained_per_sec"] or 0) >
+                        (best["fleet_merged_sustained_per_sec"] or 0)):
+                    best = point
+            else:
+                # higher rates at this proc count fail harder — skip
+                # them, EXCEPT the >=10k rung runs once regardless so
+                # the 10k/s attempt is on the record either way
+                skip_rest = True
+    # headline honesty: a sustained point's merged rate, else the best
+    # observed merged rate explicitly flagged unsustained
+    if best is not None:
+        head, sustained = best, True
+    else:
+        head = max(points,
+                   key=lambda p: p["fleet_merged_sustained_per_sec"] or 0)
+        sustained = False
+    return {
+        "mode": "funnel_10k",
+        "topology": "shared",
+        "single_process_baseline_per_sec": 4043.0,
+        "funnel_sustained_per_sec": head["fleet_merged_sustained_per_sec"],
+        "funnel_frontend_procs": head["procs"],
+        "sustained": sustained,
+        "offered_rates_swept": list(rates),
+        "frontend_proc_ladder": proc_ladder,
+        "cpus": cpus,
+        "attempt_10k": attempt_10k,
+        "points": points,
+    }
+
+
+def _funnel_10k() -> Optional[dict]:
+    """The ISSUE 20 rider: real multi-process 10k/s attempt through the
+    front-end->balancer admission funnel. Pure control-plane/host work —
+    always CPU-pinned (and tagged so), like the host-path rows."""
+    out = _cpu_subprocess_json("bench._funnel_10k_measure()", "RIDERJSON",
+                               "funnel_10k", force_devices=True)
+    if out is not None:
+        out["backend"] = "cpu"
+        cmp_block = _compared_to("funnel_10k", out)
+        if cmp_block is not None:
+            out["compared_to"] = cmp_block
+    return out
+
+
 def _e2e_open_loop(rate0: float = 32.0, duration: float = 2.5,
                    max_doublings: int = 9) -> Optional[dict]:
     """The ISSUE 7 headline rider: open-loop offered-rate sweep against the
@@ -3719,6 +3810,71 @@ def _host_info() -> dict:
     }
 
 
+def _reap_leaked_processes() -> list:
+    """ISSUE 20 satellite: a killed prior session can leave controller,
+    invoker, serve-funnel or loadgen worker processes holding ports and
+    stealing CPU — which silently skews every number this round reports
+    (and a leaked TcpBusServer can collide with a fresh one's port).
+    Scan /proc for this repo's long-running process signatures, SIGTERM
+    (then SIGKILL after a 5 s grace) everything that is not this process
+    or one of its ancestors, and log exactly what was reaped."""
+    import os
+    import signal
+    signatures = ("-m openwhisk_tpu.controller", "-m openwhisk_tpu.invoker",
+                  "-m openwhisk_tpu.messaging", "-m openwhisk_tpu.standalone",
+                  "openwhisk_tpu/controller/__main__",
+                  "openwhisk_tpu/invoker/__main__",
+                  "containerpool/actionproxy.py",
+                  "--serve-funnel", "tools/loadgen.py")
+    keep = set()
+    pid = os.getpid()
+    while pid > 1:  # never kill ourselves or the driver chain above us
+        keep.add(pid)
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                # field 4 (after the parenthesized comm, which may itself
+                # contain spaces) is the ppid
+                pid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:
+        return []
+    reaped = []
+    for p in pids:
+        if p in keep:
+            continue
+        try:
+            with open(f"/proc/{p}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace").strip()
+        except OSError:
+            continue
+        if not any(s in cmd for s in signatures):
+            continue
+        try:
+            os.kill(p, signal.SIGTERM)
+        except OSError:
+            continue
+        reaped.append({"pid": p, "cmd": cmd[:160]})
+    if reaped:
+        deadline = time.monotonic() + 5.0
+        live = {r["pid"] for r in reaped}
+        while live and time.monotonic() < deadline:
+            time.sleep(0.1)
+            live = {p for p in live if os.path.exists(f"/proc/{p}")}
+        for p in live:
+            try:
+                os.kill(p, signal.SIGKILL)
+            except OSError:
+                pass
+        for r in reaped:
+            print(f"# reaped leaked process {r['pid']}: {r['cmd']}",
+                  file=sys.stderr)
+    return reaped
+
+
 def _run(args) -> Optional[dict]:
     import jax
 
@@ -3770,6 +3926,7 @@ def _run(args) -> Optional[dict]:
     placement_quality = None
     placement_quality_overhead = None
     e2e_open_loop = None
+    funnel_10k = None
     repair_vs_scan = None
     pipeline_speedup = None
     bus_coalesce_speedup = None
@@ -3784,6 +3941,10 @@ def _run(args) -> Optional[dict]:
         # the new headline first: the open-loop observatory (sustained
         # activations/s + the per-stage budget the next PR attacks)
         e2e_open_loop = timed_rider("_e2e_open_loop", _e2e_open_loop)
+        # ISSUE 20: the real multi-process deployment — front-end worker
+        # processes funneling ONE balancer process over the TCP bus,
+        # swept to the 10k/s attempt (always CPU-pinned host work)
+        funnel_10k = timed_rider("_funnel_10k", _funnel_10k)
         # the host hot-loop observatory (ISSUE 11): its payoff block is
         # the measured target list the 10k/s vectorization PR attacks,
         # and its overhead gate keeps all four planes under the house 5%
@@ -3956,6 +4117,8 @@ def _run(args) -> Optional[dict]:
         out["host_observatory"] = host_observatory
     if e2e_open_loop is not None:
         out["e2e_open_loop"] = e2e_open_loop
+    if funnel_10k is not None:
+        out["funnel_10k"] = funnel_10k
     if bus_coalesce_speedup is not None:
         out["bus_coalesce_speedup"] = bus_coalesce_speedup
     if failover_downtime is not None:
@@ -4018,6 +4181,15 @@ def main() -> None:
                     help="print an (N x A) xla-vs-pallas table to stderr")
     args = ap.parse_args()
 
+    # preamble (ISSUE 20): reap leaked prior-session service processes
+    # BEFORE any round measures — a survivor controller/invoker/loadgen
+    # fleet skews every number and can hold the bus ports
+    try:
+        reaped = _reap_leaked_processes()
+    except Exception as e:  # noqa: BLE001 — the reaper must never kill a run
+        print(f"# leaked-process reap failed: {e!r}", file=sys.stderr)
+        reaped = []
+
     # the driver contract: ONE parseable JSON line on stdout, ALWAYS — a
     # dead device/tunnel produces {"error": ...} with value null instead of
     # an rc=1 traceback and an empty BENCH_rNN.json (round-5 verdict)
@@ -4034,6 +4206,8 @@ def main() -> None:
         }))
         return
     if out is not None:
+        if reaped:
+            out["reaped_leaked_processes"] = reaped
         print(json.dumps(out))
 
 

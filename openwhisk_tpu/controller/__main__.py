@@ -60,6 +60,24 @@ def main() -> None:
                              "the DEVICE token bucket fused into the TPU "
                              "placement step (bus-boundary backstop behind "
                              "the front door's entitlement throttle)")
+    parser.add_argument("--role", choices=("all", "frontend", "balancer"),
+                        default="all",
+                        help="multi-process deployment role (ISSUE 20): "
+                             "'all' (default) = today's single-process "
+                             "path, bit-exact; 'balancer' = the device-"
+                             "owning process, additionally ingesting "
+                             "admission frames from its ctrlfunnel<N> "
+                             "topic; 'frontend' = an edge-facing worker "
+                             "whose load balancer forwards whole "
+                             "admission waves over the bus to --funnel-to")
+    parser.add_argument("--funnel-to", type=int, default=0,
+                        help="(--role frontend) instance number of the "
+                             "device-owning balancer process to funnel "
+                             "admission batches to")
+    parser.add_argument("--funnel-depth", type=int, default=None,
+                        help="(--role frontend) max rows in flight before "
+                             "the front door answers 429 (default "
+                             "CONFIG_whisk_funnel_depth or 2048)")
     args = parser.parse_args()
 
     async def run():
@@ -72,6 +90,44 @@ def main() -> None:
             provider = provider_for_bus(args.bus)
             store = open_store(args.db)
             instance = ControllerInstanceId(args.instance)
+            if args.role == "frontend":
+                # edge-facing worker process (ISSUE 20): the HTTP API,
+                # entitlement/rate admission and activation-id mint run
+                # here; placement is a wire hop — whole admission waves
+                # forward as one columnar frame to the device-owning
+                # balancer. No journal/snapshot/HA machinery: that
+                # state lives with the device.
+                from .loadbalancer.funnel import (FunnelBalancer,
+                                                  FunnelConfig)
+                fcfg = FunnelConfig.from_env()
+                if args.funnel_depth is not None:
+                    fcfg = FunnelConfig(depth=args.funnel_depth,
+                                        retry_seconds=fcfg.retry_seconds,
+                                        max_retries=fcfg.max_retries)
+                lb = FunnelBalancer(provider, instance,
+                                    target=args.funnel_to, config=fcfg,
+                                    logger=logger, metrics=logger.metrics)
+                lim = config_from_env().get("limits", {})
+                controller = Controller(
+                    instance, provider, artifact_store=store,
+                    logger=logger, load_balancer=lb,
+                    invocations_per_minute=int(
+                        lim.get("invocations_per_minute", 60)),
+                    concurrent_invocations=int(
+                        lim.get("concurrent_invocations", 30)),
+                    fires_per_minute=int(lim.get("fires_per_minute", 60)))
+                if args.seed_guest:
+                    from ..standalone import guest_identity
+                    ident = guest_identity()
+                    await controller.auth_store.put(
+                        WhiskAuthRecord(ident.subject, [ident.namespace],
+                                        [ident.authkey]))
+                await controller.start(host=args.host, port=args.port)
+                print(f"controller{args.instance} up on :{args.port} "
+                      f"(role=frontend, funnel->balancer{args.funnel_to}, "
+                      f"bus={args.bus})", flush=True)
+                await wait_for_shutdown()
+                return
             if args.balancer == "tpu":
                 from .loadbalancer.tpu_balancer import TpuBalancer
                 lb = TpuBalancer(provider, instance, logger=logger,
@@ -226,6 +282,15 @@ def main() -> None:
                     controller.spillover_receiver = SpilloverReceiver(
                         provider, instance, lb, controller.entity_store,
                         logger=logger, metrics=logger.metrics)
+            if args.role == "balancer":
+                # device-owning process (ISSUE 20): additionally ingest
+                # admission frames front-end workers funnel to our
+                # ctrlfunnel<N> topic; started/stopped with the
+                # controller (core.py lifecycle, like spillover)
+                from .loadbalancer.funnel import FunnelReceiver
+                controller.funnel_receiver = FunnelReceiver(
+                    provider, instance, lb, controller.entity_store,
+                    logger=logger, metrics=logger.metrics)
             if args.seed_guest:
                 from ..standalone import guest_identity
                 ident = guest_identity()
@@ -243,7 +308,9 @@ def main() -> None:
             print(f"controller{args.instance} up on :{args.port} "
                   f"(balancer={args.balancer}, bus={args.bus}"
                   + (f", partitions={aa_ring.n_partitions}"
-                     if aa_ring is not None else "") + ")", flush=True)
+                     if aa_ring is not None else "")
+                  + (", role=balancer" if args.role == "balancer"
+                     else "") + ")", flush=True)
             await wait_for_shutdown()
         finally:
             if snapshotter is not None:

@@ -106,6 +106,12 @@ class Controller:
         self.ha_partition_ring = None
         self.on_partitions = None
         self.spillover_receiver = None
+        # admission funnel (loadbalancer/funnel.py, ISSUE 20): the
+        # balancer-role assembler attaches a FunnelReceiver BEFORE
+        # start(); started/stopped with the controller like spillover.
+        # None (the default and the --role all path) keeps today's
+        # single-process behavior bit-exact.
+        self.funnel_receiver = None
         # fleet observatory (ISSUE 16): resolved once at assembly; start()
         # wires the admin-address announcement, the identity block and the
         # ctrlevents publisher only when enabled, so disabled stays a TRUE
@@ -227,6 +233,8 @@ class Controller:
             self.fleet_events.start()
         if self.spillover_receiver is not None:
             self.spillover_receiver.start()
+        if self.funnel_receiver is not None:
+            self.funnel_receiver.start()
         app = self.api.make_app()
         for method, path, handler in self.extra_routes:
             app.router.add_route(method, path, handler)
@@ -251,6 +259,8 @@ class Controller:
             self.fleet_events = None
         if self.spillover_receiver is not None:
             await self.spillover_receiver.stop()
+        if self.funnel_receiver is not None:
+            await self.funnel_receiver.stop()
         for resource in self.owned_resources:
             await resource.stop()
         if hasattr(self.entitlement, "close"):

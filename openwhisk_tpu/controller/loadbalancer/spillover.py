@@ -38,6 +38,7 @@ from ...messaging.columnar import ActivationBatchMessage, is_batch_payload
 from ...messaging.connector import MessageFeed, decode_batch
 from ...utils.eventlog import GLOBAL_EVENT_LOG
 from ...utils.transaction import TransactionId
+from .funnel import FrameSender
 
 SPILL_TOPIC_PREFIX = "ctrlspill"
 #: spilled work is live traffic, not history: keep a small tail only
@@ -48,16 +49,16 @@ def spill_topic(instance: int) -> str:
     return f"{SPILL_TOPIC_PREFIX}{int(instance)}"
 
 
-class SpilloverSender:
-    """The owner-side sink `TpuBalancer.publish_many` diverts into."""
+class SpilloverSender(FrameSender):
+    """The owner-side sink `TpuBalancer.publish_many` diverts into.
+    Rides the funnel's shared `FrameSender` core (ISSUE 20): the lazy
+    producer, the once-per-topic ensure and the one-task-per-frame send
+    live there now."""
 
     def __init__(self, provider, membership, metrics=None, logger=None):
-        self.provider = provider
+        super().__init__(provider, logger=logger)
         self.membership = membership
         self.metrics = metrics
-        self.logger = logger
-        self._producer = None
-        self._topics_ensured: set = set()
 
     def has_peer(self) -> bool:
         return self.membership.least_loaded_peer() is not None
@@ -80,33 +81,14 @@ class SpilloverSender:
             # acks/books/record pipeline live at the peer from here on
             msg.root_controller_index = ControllerInstanceId(str(peer))
             msgs.append(msg)
-        if self._producer is None:
-            self._producer = self.provider.get_producer()
         topic = spill_topic(peer)
-        if topic not in self._topics_ensured:
-            self.provider.ensure_topic(
-                topic, retention_bytes=SPILL_RETENTION_BYTES)
-            self._topics_ensured.add(topic)
+        self.ensure_topic(topic, SPILL_RETENTION_BYTES)
         if self.metrics is not None:
             self.metrics.counter("loadbalancer_spillover_batches")
         GLOBAL_EVENT_LOG.record("spill_burst", peer=int(peer),
                                 rows=len(msgs))
         self._emit_hop_spans(msgs, peer)
-
-        async def _send() -> None:
-            try:
-                await self._producer.send(topic, ActivationBatchMessage(msgs))
-            except Exception as e:  # noqa: BLE001 — fail the rows, not
-                # the event loop's task machinery
-                for out in outs:
-                    if not out.done():
-                        out.set_exception(e)
-                return
-            for out in outs:
-                if not out.done():
-                    out.set_result(True)
-
-        asyncio.get_event_loop().create_task(_send())
+        self.send_frame(topic, ActivationBatchMessage(msgs), outs=outs)
         return outs
 
     def _emit_hop_spans(self, msgs, peer) -> None:

@@ -64,11 +64,20 @@ KIND_ACK = "ack1"
 #: theirs), so the first b"\n" in a batch payload is always this frame
 #: delimiter and plain frames never contain one.
 KIND_ACK_LAZY = "ackL"
+#: the admission-funnel frame (ISSUE 20): an activation batch plus a
+#: (origin, seq, epoch) routing header — one front-end process's whole
+#: admission wave shipped to the device-owning balancer as one record.
+KIND_FUNNEL = "fun1"
+#: the funnel's per-row outcome frame back to the origin: placement /
+#: refusal / completion records, columnar like the ack batch.
+KIND_FUNNEL_ACK = "funA"
 
 #: serde hop labels by batch kind (mirrors connector._SERDE_HOPS so the
 #: host observatory's per-hop accounting survives the batch wire)
 _BATCH_HOPS = {KIND_ACTIVATION: "activation", KIND_ACK: "completion_ack",
-               KIND_ACK_LAZY: "completion_ack"}
+               KIND_ACK_LAZY: "completion_ack",
+               KIND_FUNNEL: "activation",
+               KIND_FUNNEL_ACK: "completion_ack"}
 
 #: the deferred result parse books its cost under its OWN hop, so the
 #: "consumer never reads the result" case is visible as a ZERO row here
@@ -474,6 +483,158 @@ class AckBatchMessage(Message):
         return out
 
 
+class FunnelFrame:
+    """Decoded `fun1` frame: the rebuilt ActivationMessages plus the
+    routing header the receiver fences/dedupes on."""
+
+    __slots__ = ("origin", "seq", "epoch", "msgs")
+
+    def __init__(self, origin: int, seq: int, epoch: int,
+                 msgs: List[ActivationMessage]):
+        self.origin = origin
+        self.seq = seq
+        self.epoch = epoch
+        self.msgs = msgs
+
+
+class FunnelBatchMessage(Message):
+    """ISSUE 20: one front-end admission wave as ONE wire record — the
+    `act1` struct-of-arrays columns (reused verbatim: dedup tables +
+    packed per-row columns) plus three routing scalars:
+
+      * `origin` — the front-end controller instance the per-row outcome
+        frames route back to (topic `ctrlfunnelack<origin>`);
+      * `seq` — the sender's frame counter. Application-level retry
+        re-ships the SAME seq, and the receiver dedupes PER ROW (the
+        `pubN` discipline one layer up): a replayed frame only places
+        rows whose first delivery was lost;
+      * `epoch` — the placement-leadership epoch the sender believes
+        current. 0 = unfenced (bootstrap; the balancer's own standby /
+        partition fences still apply row-by-row); nonzero must equal the
+        receiving balancer's live epoch or the whole frame is refused —
+        covering both the zombie sender and the demoted (stale-epoch)
+        balancer."""
+
+    def __init__(self, msgs: List[ActivationMessage], origin: int,
+                 seq: int, epoch: int = 0):
+        self.msgs = msgs
+        self.origin = int(origin)
+        self.seq = int(seq)
+        self.epoch = int(epoch)
+
+    @property
+    def activation_ids(self) -> List[str]:
+        return [m.activation_id.asString for m in self.msgs]
+
+    def to_json(self) -> dict:
+        # reuse the act1 columns; overwriting the kind keeps `whiskBatch`
+        # in first position (dict order), so the magic-prefix sniff holds
+        out = ActivationBatchMessage(self.msgs).to_json()
+        out["whiskBatch"] = KIND_FUNNEL
+        out["origin"] = self.origin
+        out["seq"] = self.seq
+        out["epoch"] = self.epoch
+        return out
+
+    @staticmethod
+    def from_json(j: dict) -> FunnelFrame:
+        msgs = ActivationBatchMessage.from_json(j)
+        return FunnelFrame(int(j["origin"]), int(j["seq"]),
+                           int(j.get("epoch", 0)), msgs)
+
+
+#: funnel outcome codes (one char per row in the `k` column):
+#:   p = placed (the row has a completion promise at the balancer)
+#:   r = refused (sparse `exc` row carries [kind-code, exact text])
+#:   c = completed (sparse `resp` row carries the activation JSON for
+#:       blocking rows; non-blocking completions ship slim)
+#:   f = forced completion timeout (the serial path's ActiveAckTimeout)
+FUNNEL_PLACED = "p"
+FUNNEL_REFUSED = "r"
+FUNNEL_COMPLETED = "c"
+FUNNEL_FORCED = "f"
+
+#: refusal kind-codes: "T" rebuilds LoadBalancerThrottleException (429
+#: at the front door), anything else a plain LoadBalancerException (503)
+FUNNEL_EXC_THROTTLE = "T"
+FUNNEL_EXC_ERROR = "L"
+
+
+class FunnelOutcome:
+    """One decoded `funA` row."""
+
+    __slots__ = ("code", "aid", "err", "exc", "resp")
+
+    def __init__(self, code: str, aid: str, err: bool = False,
+                 exc: Optional[Tuple[str, str]] = None,
+                 resp: Optional[dict] = None):
+        self.code = code
+        self.aid = aid
+        self.err = err
+        self.exc = exc
+        self.resp = resp
+
+
+class FunnelAckFrame:
+    __slots__ = ("origin", "epoch", "rows")
+
+    def __init__(self, origin: int, epoch: int, rows: List[FunnelOutcome]):
+        self.origin = origin
+        self.epoch = epoch
+        self.rows = rows
+
+
+class FunnelAckMessage(Message):
+    """N funnel outcome records as one columnar record. `epoch` is the
+    balancer's CURRENT placement epoch — senders adopt it, so a
+    bootstrap (epoch-0) sender converges to fenced frames after its
+    first outcome wave."""
+
+    def __init__(self, origin: int, epoch: int,
+                 rows: List[FunnelOutcome]):
+        self.origin = int(origin)
+        self.epoch = int(epoch)
+        self.rows = rows
+
+    def to_json(self) -> dict:
+        exc: Dict[str, list] = {}
+        resp: Dict[str, dict] = {}
+        for i, r in enumerate(self.rows):
+            if r.exc is not None:
+                exc[str(i)] = [r.exc[0], r.exc[1]]
+            if r.resp is not None:
+                resp[str(i)] = r.resp
+        out = {
+            "whiskBatch": KIND_FUNNEL_ACK,
+            "origin": self.origin,
+            "epoch": self.epoch,
+            "ids": [r.aid for r in self.rows],
+            "k": "".join(r.code for r in self.rows),
+            "err": [1 if r.err else 0 for r in self.rows],
+        }
+        if exc:
+            out["exc"] = exc
+        if resp:
+            out["resp"] = resp
+        return out
+
+    @staticmethod
+    def from_json(j: dict) -> FunnelAckFrame:
+        exc = j.get("exc") or {}
+        resp = j.get("resp") or {}
+        rows = []
+        for i, (aid, code, err) in enumerate(zip(j["ids"], j["k"],
+                                                 j["err"])):
+            key = str(i)
+            e = exc.get(key)
+            rows.append(FunnelOutcome(
+                code, aid, bool(err),
+                (e[0], e[1]) if e is not None else None,
+                resp.get(key)))
+        return FunnelAckFrame(int(j["origin"]), int(j.get("epoch", 0)),
+                              rows)
+
+
 def make_batch(family: str, msgs: list,
                lazy_results: bool = False) -> Message:
     """Wrap same-family messages into their batch record (the
@@ -516,4 +677,10 @@ def parse_batch(raw) -> Tuple[str, list]:
         return kind, ActivationBatchMessage.from_json(j)
     if kind == KIND_ACK:
         return kind, AckBatchMessage.from_json(j)
+    if kind == KIND_FUNNEL:
+        # the funnel frame decodes to ONE header-carrying object, not a
+        # message list — only the funnel receiver consumes this kind
+        return kind, FunnelBatchMessage.from_json(j)
+    if kind == KIND_FUNNEL_ACK:
+        return kind, FunnelAckMessage.from_json(j)
     raise ValueError(f"unknown batch kind {kind!r}")

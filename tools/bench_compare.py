@@ -84,6 +84,16 @@ HEADLINES = (
     ("fleet_merged_sustained_per_sec",
      ("e2e_open_loop", "multiproc_point", "fleet_merged_sustained_per_sec"),
      "higher"),
+    # ISSUE 20: the SHARED multi-process deployment — front-end worker
+    # processes funneling ONE balancer process over the TCP bus. The
+    # merged-schedule sustained rate is a system number (topology
+    # "shared"), unlike the twins-mode generator headline above; the
+    # proc count rides along so a rate regression that came from a
+    # smaller front-end ladder names itself.
+    ("funnel_sustained_per_sec",
+     ("funnel_10k", "funnel_sustained_per_sec"), "higher"),
+    ("funnel_frontend_procs",
+     ("funnel_10k", "funnel_frontend_procs"), "higher"),
     # ISSUE 17: placement quality under the straggler A/B — predicted
     # regret left on the table and how often the penalized shadow would
     # have placed differently (both lower-is-better), plus the plane's

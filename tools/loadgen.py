@@ -389,6 +389,165 @@ class _BalancerTarget:
                 await f.stop()
 
 
+# -- the shared funnel deployment (ISSUE 20) -------------------------------
+
+FUNNEL_READY_PREFIX = "FUNNELREADY:"
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _FunnelTarget:
+    """Worker-side target for the SHARED deployment (`--funnel H:P`):
+    a `FunnelBalancer` front end forwarding each admission wave as ONE
+    fence-stamped columnar frame over the TCP bus to the device-owning
+    balancer process (`--serve-funnel`). Same `one()` contract as
+    `_BalancerTarget`, but the placement/completion stages live in the
+    OTHER process — so no waterfall anchor here (the worker measures the
+    e2e the client sees; the balancer process owns the stage budget)."""
+
+    def __init__(self, endpoint: str, worker_ident: Optional[int] = None):
+        host, _, port = str(endpoint).rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        # origins 100+ keep the front-end instance ids clear of the
+        # balancer's own controller id space
+        self.origin = 100 + (worker_ident or 0)
+        self.bal = None
+        self._publish = None
+        self._actions = None
+        self._ident = None
+        self.stragglers_applied: dict = {}
+
+    async def start(self) -> None:
+        import bench
+        from openwhisk_tpu.controller.loadbalancer.base import \
+            maybe_batch_publish
+        from openwhisk_tpu.controller.loadbalancer.funnel import \
+            FunnelBalancer
+        from openwhisk_tpu.core.entity import ControllerInstanceId, Identity
+        from openwhisk_tpu.messaging.tcp import TcpMessagingProvider
+
+        from openwhisk_tpu.utils.waterfall import GLOBAL_WATERFALL
+        # the placement stages live in the balancer process: this
+        # worker never stamps a waterfall, so keep the plane off here
+        GLOBAL_WATERFALL.enabled = False
+        provider = TcpMessagingProvider(self.host, self.port)
+        self.bal = FunnelBalancer(provider,
+                                  ControllerInstanceId(str(self.origin)),
+                                  target=0)
+        # the same front-door coalescer the controller's invoke path
+        # uses: one API wave -> one publish_many -> one wire frame
+        self._publish = maybe_batch_publish(self.bal)
+        await self.bal.start()
+        self._actions = [bench._bench_action(f"ol{i}", memory=128)
+                         for i in range(8)]
+        self._ident = Identity.generate("guest")
+
+    async def one(self, i: int, sched_ns: int) -> bool:
+        from openwhisk_tpu.core.entity import (ActivationId,
+                                               ControllerInstanceId)
+        from openwhisk_tpu.messaging import ActivationMessage
+        from openwhisk_tpu.utils.transaction import TransactionId
+        action = self._actions[i % len(self._actions)]
+        msg = ActivationMessage(
+            TransactionId(), action.fully_qualified_name, action.rev.rev,
+            self._ident, ActivationId.generate(), ControllerInstanceId("0"),
+            True, {})
+        try:
+            if self._publish is not None:
+                promise = await self._publish.publish(action, msg)
+            else:
+                promise = await self.bal.publish(action, msg)
+            await promise
+            return True
+        except Exception:  # noqa: BLE001 — a 429/503 is an error sample
+            return False
+
+    async def stop(self) -> None:
+        if self.bal is not None:
+            await self.bal.close()
+
+
+def serve_funnel(n_invokers: int = 16, kernel: str = "auto",
+                 port: Optional[int] = None) -> None:
+    """The balancer-role process of the shared deployment: boots the TCP
+    bus broker on a free port, the ONE TpuBalancer owning the (simulated)
+    device fleet, the echo-invoker fleet, and a `FunnelReceiver` draining
+    `ctrlfunnel0`. Prints `FUNNELREADY:{"port": P}` once the fleet is
+    healthy, then serves until stdin closes (the parent's shutdown
+    signal) or SIGTERM."""
+
+    async def go() -> None:
+        import bench
+        import signal
+        import threading
+        from openwhisk_tpu.controller.loadbalancer import TpuBalancer
+        from openwhisk_tpu.controller.loadbalancer.base import HEALTHY
+        from openwhisk_tpu.controller.loadbalancer.funnel import \
+            FunnelReceiver
+        from openwhisk_tpu.core.entity import ControllerInstanceId
+        from openwhisk_tpu.messaging.tcp import (TcpBusServer,
+                                                 TcpMessagingProvider)
+
+        p = port or _free_port()
+        server = TcpBusServer("127.0.0.1", p)
+        await server.start()
+        provider = TcpMessagingProvider("127.0.0.1", p)
+        bal = TpuBalancer(provider, ControllerInstanceId("0"),
+                          managed_fraction=1.0, blackbox_fraction=0.0,
+                          kernel=kernel, prewarm=False)
+        await bal.start()
+        feeds, fleet_stop = await bench._echo_fleet(provider, n_invokers)
+        for _ in range(120):
+            health = await bal.invoker_health()
+            if sum(h.status == HEALTHY for h in health) >= n_invokers:
+                break
+            await asyncio.sleep(0.25)
+        else:
+            raise RuntimeError("serve-funnel: fleet never became healthy")
+        # no entity store in the harness: resolve the workers' fixed
+        # action set from a dict (same 8 actions every worker mints)
+        by_name = {}
+        for i in range(8):
+            a = bench._bench_action(f"ol{i}", memory=128)
+            by_name[str(a.fully_qualified_name)] = a
+
+        async def resolver(name: str, rev: str):
+            return by_name[name]
+
+        recv = FunnelReceiver(provider, ControllerInstanceId("0"), bal,
+                              resolver=resolver)
+        recv.start()
+        print(FUNNEL_READY_PREFIX + json.dumps({"port": p}), flush=True)
+
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop_ev.set)
+
+        def watch_stdin() -> None:
+            try:
+                sys.stdin.read()
+            except Exception:  # noqa: BLE001 — EOF either way
+                pass
+            loop.call_soon_threadsafe(stop_ev.set)
+
+        threading.Thread(target=watch_stdin, daemon=True).start()
+        await stop_ev.wait()
+        await recv.stop()
+        await fleet_stop()
+        for f in feeds:
+            await f.stop()
+        await bal.close()
+        await server.stop()
+
+    asyncio.run(go())
+
+
 async def _measure_step(target: _BalancerTarget, rate: float,
                         duration: float, dist: str, seed: int,
                         reset_waterfall: bool = True,
@@ -420,7 +579,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                    keep_samples: bool = False,
                    worker_ident: Optional[int] = None,
                    stragglers=None, trace_keep_all: bool = False,
-                   trace_export: Optional[str] = None) -> dict:
+                   trace_export: Optional[str] = None,
+                   funnel: Optional[str] = None) -> dict:
     """The observatory: sweep offered rate (doubling from `rate0`) to the
     max sustainable throughput, then re-measure that rate for the headline
     row + the waterfall's per-stage budget. `fixed_rate` skips the sweep
@@ -473,9 +633,15 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                     keep_ring=65536)
                 GLOBAL_TRACE_STORE._floor_every = 1
             GLOBAL_TRACE_STORE.reset()
-        target = _BalancerTarget(n_invokers=n_invokers, kernel=kernel,
-                                 waterfall=waterfall, fleet_mesh=fleet_mesh,
-                                 stragglers=stragglers)
+        if funnel:
+            # shared deployment worker: the system under test lives in
+            # the --serve-funnel process; this process is front end only
+            target = _FunnelTarget(funnel, worker_ident)
+        else:
+            target = _BalancerTarget(n_invokers=n_invokers, kernel=kernel,
+                                     waterfall=waterfall,
+                                     fleet_mesh=fleet_mesh,
+                                     stragglers=stragglers)
         await target.start()
         gc_tuned = None
         if gc_tune:
@@ -617,7 +783,8 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                       else None)
             tail = (GLOBAL_WATERFALL.tail_attribution()
                     if GLOBAL_WATERFALL.enabled else None)
-            if budget and head["p50_ms"]:
+            if budget and head["p50_ms"] and \
+                    budget.get("p50_decomposition_sum_ms") is not None:
                 # the EXTERNAL accounting check: the waterfall's stage
                 # budget vs the generator's own independently measured
                 # e2e median (both anchored at scheduled arrival) — this
@@ -651,6 +818,7 @@ def sweep_balancer(rate0: float = 32.0, duration: float = 2.5,
                     traces_exported = n_exp
             return {
                 "mode": "open_loop",
+                "funnel_endpoint": funnel,
                 "dist": dist,
                 "gc_tuned": gc_tuned,
                 "stragglers": {str(k): v for k, v
@@ -692,7 +860,8 @@ def multiproc_fixed_rate(rate: float, procs: int, duration: float = 2.5,
                          fleet_mesh: bool = False, gc_tune: bool = True,
                          waterfall: bool = True,
                          host_observatory: bool = False,
-                         timeout_s: float = 600.0) -> dict:
+                         timeout_s: float = 600.0,
+                         shared: bool = False) -> dict:
     """`--procs N`: the multi-process generator (ROADMAP item 1's "keep
     the verdict honest" note). At 4k+ offered/s ONE Python generator loop
     is itself a measurable fraction of the box: its task churn and GC
@@ -707,78 +876,154 @@ def multiproc_fixed_rate(rate: float, procs: int, duration: float = 2.5,
     the specific worker (gc_pause vs event_loop_stall) instead of the
     fleet.
 
-    Honesty note, by design: each worker drives its OWN balancer + echo
-    fleet twin (the in-process publish entry point cannot be shared
-    across processes until the front end itself is multi-process —
-    ROADMAP item 1's remaining step). The merged number is therefore N
-    generator-honest twins at rate/N each, the right verdict when the
-    question is "is the GENERATOR the bottleneck", and says so in
-    `targets`."""
+    Honesty note, by design (`topology: "twins"`): each worker drives
+    its OWN balancer + echo fleet twin (the in-process publish entry
+    point cannot be shared across processes). The merged number is
+    therefore N generator-honest twins at rate/N each, the right verdict
+    when the question is "is the GENERATOR the bottleneck", and says so
+    in `targets`.
+
+    `shared=True` (`topology: "shared"`, ISSUE 20) removes that caveat:
+    ONE `--serve-funnel` balancer process owns the device fleet, and the
+    N workers are front-end processes forwarding their admission waves
+    over the TCP bus funnel. The merged-schedule sustained rate is then
+    the SYSTEM-under-test headline — one shared balancer really placed
+    every row — which is exactly the number the twins mode must not
+    claim."""
     import subprocess
 
     procs = max(1, int(procs))
     share = rate / procs
-    workers = []
-    for i in range(procs):
-        cmd = [sys.executable, os.path.abspath(__file__),
-               "--rate", str(share), "--duration", str(duration),
-               "--dist", dist, "--invokers", str(n_invokers),
-               "--kernel", kernel, "--seed", str(seed + 1009 * (i + 1)),
-               "--p99-bound-ms", str(p99_bound_ms), "--emit-samples"]
-        if fleet_mesh:
-            cmd.append("--fleet-mesh")
-        if not gc_tune:
-            cmd.append("--no-gc-tune")
-        if not waterfall:
-            cmd.append("--no-waterfall")
-        if host_observatory:
-            # each worker stamps its fleet identity and emits raw integer
-            # bucket counts; the parent merges them into ONE fleet
-            # snapshot (ISSUE 16) instead of N per-worker blobs
-            cmd += ["--host-observatory", "--worker-ident", str(i)]
-        workers.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                        stderr=subprocess.PIPE,
-                                        text=True))
-    rows: List[Optional[dict]] = []
-    stderr_tails: List[Optional[str]] = []
-    # one shared deadline for the whole fleet: the workers run
-    # CONCURRENTLY, so the sequential reap hands each communicate() the
-    # time REMAINING, not a fresh full budget (procs wedged workers must
-    # cost ~timeout_s total, not procs * timeout_s)
-    deadline = time.monotonic() + timeout_s
-    for p in workers:
-        try:
-            out, err = p.communicate(
-                timeout=max(0.0, deadline - time.monotonic()))
-            row = None
-            for line in reversed(out.splitlines()):
-                line = line.strip()
-                if line.startswith("{"):
-                    try:
-                        row = json.loads(line)
-                    except ValueError:
-                        # a partial flush from a dying worker (or a
-                        # '{'-prefixed log line) must not crash the
-                        # parent and discard every OTHER worker's row
-                        continue
-                    break
-            rows.append(row)
-            # keep a diagnostic tail so a dead worker's traceback (or its
-            # own error-fallback JSON) survives into the per_worker row
-            stderr_tails.append(err[-500:] if err else None)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            # reap the killed child (no zombie, no Popen ResourceWarning)
-            # and drain its pipes so partial diagnostics survive
+    serve = None
+    funnel_endpoint = None
+    balancer_note = None
+    serve_err = None
+    if shared:
+        import tempfile
+        serve_cmd = [sys.executable, os.path.abspath(__file__),
+                     "--serve-funnel", "--invokers", str(n_invokers),
+                     "--kernel", kernel]
+        # stderr to a spool file: the balancer process outlives the
+        # workers and logs freely — a PIPE would fill and wedge it
+        serve_err = tempfile.TemporaryFile(mode="w+")
+        serve = subprocess.Popen(serve_cmd, stdin=subprocess.PIPE,
+                                 stdout=subprocess.PIPE,
+                                 stderr=serve_err, text=True)
+        ready_by = time.monotonic() + 120.0
+        while time.monotonic() < ready_by:
+            line = serve.stdout.readline()
+            if not line:
+                break  # balancer process died before becoming ready
+            if line.startswith(FUNNEL_READY_PREFIX):
+                p = json.loads(line[len(FUNNEL_READY_PREFIX):])["port"]
+                funnel_endpoint = f"127.0.0.1:{p}"
+                break
+        if funnel_endpoint is None:
+            serve.kill()
             try:
-                _out, err = p.communicate(timeout=10.0)
+                serve.wait(timeout=10.0)
             except Exception:  # noqa: BLE001 — diagnostics only
-                err = ""
-            rows.append(None)
-            tail = f"worker timed out after {timeout_s:.0f}s"
-            if err:
-                tail += f"; stderr tail: {err[-400:]}"
-            stderr_tails.append(tail)
+                pass
+            serve_err.seek(0)
+            err = serve_err.read()
+            serve_err.close()
+            raise RuntimeError(
+                "shared deployment: balancer process never became ready"
+                + (f"; stderr tail: {err[-400:]}" if err else ""))
+    try:
+        workers = []
+        for i in range(procs):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--rate", str(share), "--duration", str(duration),
+                   "--dist", dist, "--invokers", str(n_invokers),
+                   "--kernel", kernel, "--seed",
+                   str(seed + 1009 * (i + 1)),
+                   "--p99-bound-ms", str(p99_bound_ms), "--emit-samples"]
+            if shared:
+                # funnel worker: front end only — the waterfall stages
+                # live in the balancer process, and the worker ident
+                # keys its funnel origin instance
+                cmd += ["--funnel", funnel_endpoint, "--no-waterfall",
+                        "--worker-ident", str(i)]
+            if fleet_mesh:
+                cmd.append("--fleet-mesh")
+            if not gc_tune:
+                cmd.append("--no-gc-tune")
+            if not waterfall and not shared:
+                cmd.append("--no-waterfall")
+            if host_observatory:
+                # each worker stamps its fleet identity and emits raw
+                # integer bucket counts; the parent merges them into ONE
+                # fleet snapshot (ISSUE 16) instead of N per-worker blobs
+                cmd.append("--host-observatory")
+                if not shared:
+                    cmd += ["--worker-ident", str(i)]
+            workers.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                            stderr=subprocess.PIPE,
+                                            text=True))
+        rows: List[Optional[dict]] = []
+        stderr_tails: List[Optional[str]] = []
+        # one shared deadline for the whole fleet: the workers run
+        # CONCURRENTLY, so the sequential reap hands each communicate() the
+        # time REMAINING, not a fresh full budget (procs wedged workers must
+        # cost ~timeout_s total, not procs * timeout_s)
+        deadline = time.monotonic() + timeout_s
+        for p in workers:
+            try:
+                out, err = p.communicate(
+                    timeout=max(0.0, deadline - time.monotonic()))
+                row = None
+                for line in reversed(out.splitlines()):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        try:
+                            row = json.loads(line)
+                        except ValueError:
+                            # a partial flush from a dying worker (or a
+                            # '{'-prefixed log line) must not crash the
+                            # parent and discard every OTHER worker's row
+                            continue
+                        break
+                rows.append(row)
+                # keep a diagnostic tail so a dead worker's traceback (or its
+                # own error-fallback JSON) survives into the per_worker row
+                stderr_tails.append(err[-500:] if err else None)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                # reap the killed child (no zombie, no Popen ResourceWarning)
+                # and drain its pipes so partial diagnostics survive
+                try:
+                    _out, err = p.communicate(timeout=10.0)
+                except Exception:  # noqa: BLE001 — diagnostics only
+                    err = ""
+                rows.append(None)
+                tail = f"worker timed out after {timeout_s:.0f}s"
+                if err:
+                    tail += f"; stderr tail: {err[-400:]}"
+                stderr_tails.append(tail)
+    finally:
+        if serve is not None:
+            # shutdown signal is stdin EOF; fall back to kill on a wedge
+            try:
+                serve.stdin.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                serve.wait(timeout=30.0)
+            except Exception:  # noqa: BLE001 — includes TimeoutExpired
+                serve.kill()
+                try:
+                    serve.wait(timeout=10.0)
+                except Exception:  # noqa: BLE001
+                    pass
+            err = ""
+            try:
+                serve_err.seek(0)
+                err = serve_err.read()
+                serve_err.close()
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
+            balancer_note = err[-500:] if err else None
     ok_rows = [r for r in rows if r and (r.get("headline") or {})]
     samples = sorted(s for r in ok_rows
                      for s in (r.get("headline") or {}).get("samples_ms")
@@ -832,15 +1077,25 @@ def multiproc_fixed_rate(rate: float, procs: int, duration: float = 2.5,
     fleet_sustained_per_sec = round(
         sum(w.get("throughput_per_sec") or 0.0
             for w in per_worker if "error" not in w), 1)
+    if shared:
+        targets = ("one shared balancer+fleet process behind the " +
+                   str(procs) + "-worker admission funnel; the merged-"
+                   "schedule sustained rate IS the system-under-test "
+                   "headline")
+    else:
+        targets = ("one balancer+fleet twin per worker (generator-"
+                   "honesty mode; the single-process headline remains "
+                   "the system-under-test number)")
     return {
         "mode": "open_loop_multiproc",
+        "topology": "shared" if shared else "twins",
         "procs": procs,
         "dist": dist,
         "offered_rate": rate,
         "per_worker_rate": share,
-        "targets": "one balancer+fleet twin per worker (generator-honesty "
-                   "mode; the single-process headline remains the "
-                   "system-under-test number)",
+        "targets": targets,
+        "funnel_endpoint": funnel_endpoint,
+        "balancer_stderr_tail": balancer_note,
         "sustained": bool(all_sustained
                           and merged_p99 is not None
                           and merged_p99 <= p99_bound_ms),
@@ -880,6 +1135,25 @@ def main() -> None:
                     help="skip the harness GC tuning (freeze + raised "
                          "thresholds); default is tuned, reported in "
                          "`gc_tuned`")
+    ap.add_argument("--serve-funnel", action="store_true",
+                    help="run the SHARED deployment's balancer-role "
+                         "process: TCP bus broker + the one device-"
+                         "owning balancer + echo fleet + FunnelReceiver; "
+                         "prints FUNNELREADY:{\"port\": P} when healthy "
+                         "and serves until stdin closes")
+    ap.add_argument("--serve-port", type=int, default=None,
+                    help="fixed port for --serve-funnel (default: pick "
+                         "a free one)")
+    ap.add_argument("--funnel", default=None, metavar="HOST:PORT",
+                    help="worker mode for the shared deployment: drive a "
+                         "FunnelBalancer front end against the "
+                         "--serve-funnel process at HOST:PORT instead of "
+                         "an in-process balancer twin")
+    ap.add_argument("--shared", action="store_true",
+                    help="with --procs N: ONE shared balancer process "
+                         "(auto-spawned --serve-funnel) fed by N funnel "
+                         "front-end workers — topology 'shared' — "
+                         "instead of N independent balancer twins")
     ap.add_argument("--procs", type=int, default=1,
                     help="fork N worker generators with partitioned "
                          "Poisson schedules at rate/N each and merge the "
@@ -913,10 +1187,16 @@ def main() -> None:
                          "(CONFIG_whisk_loadBalancer_fleetMesh semantics; "
                          "shard count = visible devices pow2-floored)")
     args = ap.parse_args()
+    if args.serve_funnel:
+        # the balancer-role process never prints a JSON verdict line —
+        # its contract is the FUNNELREADY line + serving until EOF
+        serve_funnel(n_invokers=args.invokers, kernel=args.kernel,
+                     port=args.serve_port)
+        return
     try:
-        if args.procs > 1:
+        if args.procs > 1 or args.shared:
             if args.rate is None:
-                ap.error("--procs requires --rate (fixed-rate "
+                ap.error("--procs/--shared requires --rate (fixed-rate "
                          "measurement; sweeps stay single-process)")
             if args.stragglers:
                 ap.error("--stragglers is single-process only (each "
@@ -933,7 +1213,8 @@ def main() -> None:
                 seed=args.seed, fleet_mesh=args.fleet_mesh,
                 gc_tune=not args.no_gc_tune,
                 waterfall=not args.no_waterfall,
-                host_observatory=args.host_observatory)
+                host_observatory=args.host_observatory,
+                shared=args.shared)
         else:
             out = sweep_balancer(rate0=args.rate0, duration=args.duration,
                                  p99_bound_ms=args.p99_bound_ms,
@@ -951,7 +1232,8 @@ def main() -> None:
                                  worker_ident=args.worker_ident,
                                  stragglers=args.stragglers,
                                  trace_keep_all=args.trace_keep_all,
-                                 trace_export=args.trace_export)
+                                 trace_export=args.trace_export,
+                                 funnel=args.funnel)
     except Exception as e:  # noqa: BLE001 — one parseable line, always
         import traceback
         traceback.print_exc(file=sys.stderr)
